@@ -1,0 +1,37 @@
+"""learning_at_home_tpu — a TPU-native decentralized Mixture-of-Experts framework.
+
+A ground-up re-design of the Learning@home system (reference:
+mryab/learning-at-home, NeurIPS 2020 "Towards Crowdsourced Training of Large
+Neural Networks using Decentralized Mixture-of-Experts") for TPU hardware:
+
+- Expert compute is JAX/XLA: experts live as HBM-resident parameter pytrees,
+  executed by jitted forward / backward+update computations with buffer
+  donation (the server-side *asynchronous SGD* step of the reference's
+  ``ExpertBackend.backward``).
+- Intra-pod expert parallelism is a single ``shard_map``-ed program with
+  ``lax.all_to_all`` token dispatch over ICI (``parallel/``), not N
+  point-to-point RPCs.
+- Inter-pod / cross-peer traffic keeps the reference's contract: a Kademlia
+  DHT control plane with expiring records for discovery & failure detection
+  (``dht/``) and a framed binary tensor RPC data plane (``server/``,
+  ``client/``) — but asyncio-native and pickle-free.
+
+Layer map (SURVEY.md §1): utils (L1) → dht (L2) → server (L3) → client (L4)
+→ models (L5).
+"""
+
+__version__ = "0.1.0"
+
+from learning_at_home_tpu.utils.nested import nested_flatten, nested_pack
+from learning_at_home_tpu.utils.serialization import (
+    pack_message,
+    unpack_message,
+)
+
+__all__ = [
+    "nested_flatten",
+    "nested_pack",
+    "pack_message",
+    "unpack_message",
+    "__version__",
+]

@@ -1,0 +1,84 @@
+"""Async/thread plumbing bridging JAX host code and asyncio networking.
+
+The reference bridges its handler *processes*, pools, and the device loop
+with ``mp.Pipe`` + custom mp-aware futures (``hivemind/utils/threading.py``
+— SURVEY.md §2; unverifiable refs, mount empty).  The TPU build is
+share-nothing in a different way: XLA dispatch releases the GIL, so one
+process with (a) asyncio event loops for all networking and (b) a single
+device-executor thread per chip gives the same isolation without pickled
+pipes.  These helpers are the glue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Awaitable, Callable, Optional
+
+
+def switch_to_uvloop() -> asyncio.AbstractEventLoop:
+    """Return a fresh event loop (uvloop if available, stdlib otherwise)."""
+    try:  # pragma: no cover - uvloop not present in this environment
+        import uvloop
+
+        return uvloop.new_event_loop()
+    except ImportError:
+        return asyncio.new_event_loop()
+
+
+def run_in_background(fn: Callable, *args, daemon: bool = True, **kwargs) -> threading.Thread:
+    """Run ``fn(*args, **kwargs)`` in a daemon thread; return the thread."""
+    thread = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=daemon)
+    thread.start()
+    return thread
+
+
+class BackgroundLoop:
+    """An asyncio event loop running forever in a dedicated thread.
+
+    All networking (RPC clients, DHT node, connection handlers) runs on
+    background loops; synchronous JAX host code submits coroutines with
+    :meth:`run` / :meth:`submit`.  This replaces the reference's
+    process-per-component + mp.Pipe architecture.
+    """
+
+    def __init__(self, name: str = "lah-loop"):
+        self.loop = switch_to_uvloop()
+        self._started = threading.Event()
+        self._shutdown = False
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+        self._started.wait()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def submit(self, coro: Awaitable) -> concurrent.futures.Future:
+        """Schedule a coroutine; return a concurrent future (non-blocking)."""
+        if self._shutdown:
+            raise RuntimeError("BackgroundLoop is shut down")
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def run(self, coro: Awaitable, timeout: Optional[float] = None) -> Any:
+        """Schedule a coroutine and block until its result."""
+        return self.submit(coro).result(timeout)
+
+    def shutdown(self) -> None:
+        """Stop the loop; pending submissions are cancelled. Idempotent."""
+        if self._shutdown:
+            return
+        self._shutdown = True
+
+        def _stop() -> None:
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        if self.loop.is_running():
+            self.loop.call_soon_threadsafe(_stop)
+        self.thread.join(timeout=5)
+        if not self.thread.is_alive() and not self.loop.is_closed():
+            self.loop.close()

@@ -1,0 +1,48 @@
+"""Nested-structure helpers: flatten / repack arbitrary pytrees of arrays.
+
+Behavioral parity with the reference's ``hivemind/utils/nested.py``
+(``nested_flatten`` / ``nested_pack`` — SURVEY.md §2 "Nested structures";
+file:line unverifiable, reference mount empty, see SURVEY.md §0): experts can
+accept and return arbitrary nests of tensors over the wire.  TPU-native
+realization: we delegate to ``jax.tree_util`` so the *same* treedef machinery
+that drives jit tracing drives the wire format — a schema string derived from
+the treedef travels in the RPC header, so client and server never need to
+agree on structure out-of-band.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+import jax
+
+
+def nested_flatten(t: Any) -> list[Any]:
+    """Flatten an arbitrary nest of containers into a flat list of leaves."""
+    return jax.tree_util.tree_leaves(t)
+
+
+def nested_structure(t: Any):
+    """Return the treedef describing the nest (pair with ``nested_pack``)."""
+    return jax.tree_util.tree_structure(t)
+
+
+def nested_pack(flat: Iterable[Any], structure: Any) -> Any:
+    """Inverse of :func:`nested_flatten`.
+
+    ``structure`` may be a treedef (from :func:`nested_structure`) or an
+    example pytree whose structure is reused.
+    """
+    if not isinstance(structure, jax.tree_util.PyTreeDef):
+        structure = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(structure, list(flat))
+
+
+def nested_map(fn, *trees: Any) -> Any:
+    """Map ``fn`` over corresponding leaves of one or more nests."""
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def nested_compare(t1: Any, t2: Any) -> bool:
+    """True iff two nests share the same structure (leaf values ignored)."""
+    return jax.tree_util.tree_structure(t1) == jax.tree_util.tree_structure(t2)

@@ -1,0 +1,126 @@
+"""Unit tests for L1 utilities: wire format, nested structures, timed storage.
+
+Mirrors the reference's pure unit-test tier (SURVEY.md §4: serializer
+round-trip, nested flatten/pack)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from learning_at_home_tpu.utils.nested import (
+    nested_compare,
+    nested_flatten,
+    nested_pack,
+    nested_structure,
+)
+from learning_at_home_tpu.utils.serialization import (
+    MSGPackSerializer,
+    pack_message,
+    recv_frame,
+    send_frame,
+    unpack_message,
+)
+from learning_at_home_tpu.utils.timed_storage import TimedStorage, get_dht_time
+
+
+def test_pack_unpack_roundtrip():
+    tensors = [
+        np.random.randn(3, 4).astype(np.float32),
+        np.arange(7, dtype=np.int32),
+        np.random.randn(2, 2, 2).astype(np.float64),
+        np.array(3.5, dtype=np.float32),  # scalar
+    ]
+    meta = {"uid": "ffn.0.1", "k": 2, "nested": {"a": [1, 2]}}
+    payload = pack_message("forward", tensors, meta)
+    msg_type, out, out_meta = unpack_message(payload)
+    assert msg_type == "forward"
+    assert out_meta == meta
+    assert len(out) == len(tensors)
+    for a, b in zip(tensors, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pack_bfloat16():
+    import ml_dtypes
+
+    t = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    _, (out,), _ = unpack_message(pack_message("fwd", [t]))
+    assert out.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(np.asarray(t, np.float32), np.asarray(out, np.float32))
+
+
+def test_pack_jax_arrays():
+    import jax.numpy as jnp
+
+    t = jnp.ones((4, 4), jnp.bfloat16) * 2
+    _, (out,), _ = unpack_message(pack_message("fwd", [t]))
+    assert out.shape == (4, 4)
+    np.testing.assert_array_equal(np.asarray(t, np.float32), np.asarray(out, np.float32))
+
+
+def test_msgpack_serializer():
+    obj = {"experts": ["ffn.0", "ffn.1"], "endpoint": ["1.2.3.4", 8080], "x": 1.5}
+    assert MSGPackSerializer.loads(MSGPackSerializer.dumps(obj)) == obj
+
+
+def test_frame_send_recv():
+    async def run():
+        server_got = []
+
+        async def handler(reader, writer):
+            server_got.append(await recv_frame(reader))
+            await send_frame(writer, b"pong" * 1000)
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await send_frame(writer, b"ping" * 5000)
+        reply = await recv_frame(reader)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        assert server_got == [b"ping" * 5000]
+        assert reply == b"pong" * 1000
+
+    asyncio.run(run())
+
+
+def test_nested_roundtrip():
+    tree = {"a": np.ones(3), "b": (np.zeros(2), [np.array(1.0), {"c": np.array(2)}])}
+    leaves = nested_flatten(tree)
+    assert len(leaves) == 4
+    rebuilt = nested_pack(leaves, nested_structure(tree))
+    assert nested_compare(tree, rebuilt)
+    rebuilt2 = nested_pack(leaves, tree)  # example-tree form
+    assert nested_compare(tree, rebuilt2)
+
+
+def test_timed_storage_expiry(monkeypatch):
+    now = [1000.0]
+    monkeypatch.setattr(
+        "learning_at_home_tpu.utils.timed_storage.get_dht_time", lambda: now[0]
+    )
+    store = TimedStorage()
+    assert store.store("k", "v1", 1010.0)
+    assert not store.store("k", "v0", 1005.0)  # older expiration loses
+    assert store.get("k") == ("v1", 1010.0)
+    now[0] = 1011.0
+    assert store.get("k") is None
+    assert len(store) == 0
+
+
+def test_timed_storage_maxsize(monkeypatch):
+    now = [0.0]
+    monkeypatch.setattr(
+        "learning_at_home_tpu.utils.timed_storage.get_dht_time", lambda: now[0]
+    )
+    store = TimedStorage(maxsize=2)
+    store.store("a", 1, 10.0)
+    store.store("b", 2, 20.0)
+    store.store("c", 3, 30.0)
+    assert len(store) == 2
+    assert store.get("a") is None  # earliest-expiring evicted
+    assert store.get("b") and store.get("c")
