@@ -1,0 +1,8 @@
+from learning_at_home_tpu.client.expert import RemoteExpert
+from learning_at_home_tpu.client.rpc import (
+    client_loop,
+    pool_registry,
+    reset_client_rpc,
+)
+
+__all__ = ["RemoteExpert", "client_loop", "pool_registry", "reset_client_rpc"]

@@ -1,0 +1,130 @@
+"""RemoteExpert: a network-remote expert that behaves like a local function.
+
+Contract from the reference's ``hivemind/client/expert.py`` (SURVEY.md §2;
+unverifiable refs, mount empty): ``RemoteExpert`` is an ``nn.Module`` whose
+forward serializes inputs and RPCs the server; a custom autograd Function
+makes ``backward`` issue a second RPC that returns input-gradients (and, as
+a side effect, triggers the server's async optimizer step).
+
+TPU-native realization: a ``jax.custom_vjp`` function whose primal and
+cotangent rules are **host callbacks** (``jax.experimental.io_callback``)
+doing the framed RPC.  This composes with jit: a training step containing
+remote experts compiles into one XLA program with host-offload points where
+the network call happens; grads flow through ``jax.grad`` transparently.
+Faults here RAISE (single-expert semantics, matching the reference);
+k-of-n fault *tolerance* lives in RemoteMixtureOfExperts.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import io_callback
+
+from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+from learning_at_home_tpu.utils.connection import Endpoint
+
+logger = logging.getLogger(__name__)
+
+
+class RemoteExpert:
+    """Stub for one expert hosted on a remote Server.
+
+    ``output_spec_fn(*input_specs) -> spec`` maps input ShapeDtypeStructs to
+    the output spec (io_callback needs static result shapes); the default —
+    output shaped like the first input — covers the standard expert blocks.
+    """
+
+    def __init__(
+        self,
+        uid: str,
+        endpoint: Endpoint,
+        timeout: float = 30.0,
+        output_spec_fn: Optional[Callable] = None,
+    ):
+        self.uid = uid
+        self.endpoint = (endpoint[0], int(endpoint[1]))
+        self.timeout = timeout
+        self.output_spec_fn = output_spec_fn or (lambda *specs: specs[0])
+        self._call = self._build_custom_vjp()
+
+    # ---- blocking host-side RPCs (also used by the MoE layer) ----
+
+    async def _rpc(self, msg_type, tensors, meta):
+        pool = pool_registry().get(self.endpoint)
+        return await pool.rpc(msg_type, tensors, meta, timeout=self.timeout)
+
+    def forward_blocking(self, inputs: Sequence[np.ndarray]) -> list[np.ndarray]:
+        tensors, _ = client_loop().run(
+            self._rpc("forward", inputs, {"uid": self.uid})
+        )
+        return tensors
+
+    def backward_blocking(
+        self, inputs: Sequence[np.ndarray], grad_outputs: Sequence[np.ndarray]
+    ) -> list[np.ndarray]:
+        tensors, _ = client_loop().run(
+            self._rpc(
+                "backward",
+                [*inputs, *grad_outputs],
+                {"uid": self.uid, "n_inputs": len(inputs)},
+            )
+        )
+        return tensors
+
+    def info(self) -> dict:
+        _, meta = client_loop().run(self._rpc("info", (), {"uid": self.uid}))
+        return meta
+
+    # ---- the jax-transformable call path ----
+
+    def _build_custom_vjp(self):
+        def host_forward(*inputs):
+            out = self.forward_blocking([np.asarray(x) for x in inputs])[0]
+            return out
+
+        def host_backward(*args):
+            *inputs, grad_out = [np.asarray(a) for a in args]
+            grads = self.backward_blocking(inputs, [grad_out])
+            return tuple(grads)
+
+        @jax.custom_vjp
+        def remote_call(*inputs):
+            out_spec = self.output_spec_fn(
+                *(jax.ShapeDtypeStruct(np.shape(x), x.dtype) for x in inputs)
+            )
+            return io_callback(
+                lambda *xs: np.asarray(host_forward(*xs), dtype=out_spec.dtype),
+                out_spec,
+                *inputs,
+            )
+
+        def fwd(*inputs):
+            return remote_call(*inputs), inputs
+
+        def bwd(residual_inputs, grad_out):
+            in_specs = tuple(
+                jax.ShapeDtypeStruct(np.shape(x), x.dtype) for x in residual_inputs
+            )
+            return io_callback(
+                lambda *args: tuple(
+                    np.asarray(g, dtype=s.dtype)
+                    for g, s in zip(host_backward(*args), in_specs)
+                ),
+                in_specs,
+                *residual_inputs,
+                grad_out,
+            )
+
+        remote_call.defvjp(fwd, bwd)
+        return remote_call
+
+    def __call__(self, *inputs):
+        """Jit/grad-compatible remote forward; backward RPCs on the vjp."""
+        return self._call(*inputs)
+
+    def __repr__(self) -> str:
+        return f"RemoteExpert({self.uid!r} @ {self.endpoint[0]}:{self.endpoint[1]})"

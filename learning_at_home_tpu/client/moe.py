@@ -1,0 +1,367 @@
+"""RemoteMixtureOfExperts: the headline DMoE layer.
+
+Contract from the reference's ``hivemind/client/moe.py`` (SURVEY.md §2 [BJ];
+unverifiable refs, mount empty): linear gating over a multi-dimensional
+expert grid (UIDs like ``ffn.4.17``); per-sample top-k expert choice among
+*alive* experts; parallel dispatch; wait for ≥ ``k_min`` replies per sample
+then a grace timeout; drop stragglers/failures; return the gate-weighted
+mixture.  Backward mirrors this with ``backward_k_min`` — and triggers the
+server-side async optimizer step on every expert that participates.
+
+TPU-native structure (who computes what):
+
+- in-graph (jit, differentiable): gate logits ``x @ W_d`` per grid dim,
+  score gathering at the chosen coordinates, masked softmax, weighted
+  mixture.  Gradients to the gate weights flow through this path.
+- host (``io_callback`` under ``jax.custom_vjp``): alive-set lookup,
+  per-sample top-k selection, per-expert row dispatch over the framed RPC
+  protocol with the k-of-n quorum, and the mirrored backward fan-out.
+  Gradients to ``x`` flow through the backward RPCs; the discrete expert
+  *choice* contributes zero gradient (straight-through on membership, exact
+  on weights — same semantics as the reference).
+
+The forward host call stashes a session (which experts answered, with which
+rows) so backward targets exactly the responding experts — the
+``_RemoteCallMany`` contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import threading
+from collections import OrderedDict, deque
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from learning_at_home_tpu.client.routing import (
+    CachedAliveSet,
+    ExpertSource,
+    make_uid,
+    select_top_k,
+)
+from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+from learning_at_home_tpu.utils.connection import Endpoint
+
+logger = logging.getLogger(__name__)
+
+
+class MoEDispatchError(RuntimeError):
+    """Quorum not reached: some sample got fewer than k_min expert replies."""
+
+
+class RemoteMixtureOfExperts:
+    """Fault-tolerant mixture over a grid of network-remote experts.
+
+    Usage::
+
+        moe = RemoteMixtureOfExperts(in_features=1024, grid_size=(32, 32),
+                                     uid_prefix="ffn", source=dht_or_static)
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        y = moe(x, gate)                      # works eagerly and under jit
+        grads = jax.grad(loss)(gate, x)       # backward RPCs happen inside
+
+    Gate parameters live client-side (trained by the caller's optimizer);
+    expert parameters live server-side (updated asynchronously by each
+    backward RPC).
+    """
+
+    _call_counter = itertools.count()
+
+    def __init__(
+        self,
+        *,
+        in_features: int,
+        grid_size: Sequence[int],
+        uid_prefix: str,
+        source: ExpertSource,
+        k_best: int = 4,
+        k_min: int = 1,
+        backward_k_min: int = 1,
+        timeout_after_k_min: float = 1.0,
+        forward_timeout: float = 30.0,
+        backward_timeout: float = 30.0,
+        alive_ttl: float = 3.0,
+        max_sessions: int = 1024,
+        compute_dtype=jnp.float32,
+    ):
+        self.in_features = in_features
+        self.grid_size = tuple(grid_size)
+        self.n_dims = len(self.grid_size)
+        self.uid_prefix = uid_prefix
+        self.k_best, self.k_min = k_best, k_min
+        self.backward_k_min = backward_k_min
+        self.timeout_after_k_min = timeout_after_k_min
+        self.forward_timeout = forward_timeout
+        self.backward_timeout = backward_timeout
+        self.compute_dtype = compute_dtype
+        self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
+        self._sessions: OrderedDict[int, dict] = OrderedDict()
+        self._sessions_lock = threading.Lock()
+        self.max_sessions = max_sessions
+        self._grid_offsets = np.concatenate(
+            [[0], np.cumsum(self.grid_size)[:-1]]
+        ).astype(np.int32)
+        self._dispatch = self._build_dispatch()
+        # dispatch latency telemetry (north-star: dispatch p50); bounded so
+        # long runs don't grow memory
+        self.dispatch_times: deque[float] = deque(maxlen=10_000)
+
+    # ---- gate parameters ----
+
+    def init_gate_params(self, rng: jax.Array) -> dict:
+        keys = jax.random.split(rng, self.n_dims)
+        scale = 1.0 / np.sqrt(self.in_features)
+        return {
+            f"w{d}": jax.random.normal(
+                keys[d], (self.in_features, g), self.compute_dtype
+            )
+            * scale
+            for d, g in enumerate(self.grid_size)
+        }
+
+    # ---- the public call: gating in-graph, dispatch via host callback ----
+
+    def __call__(self, x, gate_params: dict):
+        logits = [x @ gate_params[f"w{d}"] for d in range(self.n_dims)]
+        logits_concat = jnp.concatenate(logits, axis=-1)  # [B, sum(grid)]
+        y, idx, mask = self._dispatch(x, logits_concat)
+        # gather each chosen expert's score from the (differentiable) logits
+        scores = jnp.zeros(mask.shape, logits_concat.dtype)
+        for d in range(self.n_dims):
+            flat_idx = idx[:, :, d] + self._grid_offsets[d]
+            scores = scores + jnp.take_along_axis(logits_concat, flat_idx, axis=1)
+        scores = jnp.where(mask, scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        weights = jnp.where(mask, weights, 0.0)  # all-False rows can't occur (k_min ≥ 1)
+        return jnp.einsum("bk,bkd->bd", weights.astype(y.dtype), y)
+
+    # ---- custom-vjp dispatch crossing the network ----
+
+    def _build_dispatch(self):
+        def specs(x_shape, x_dtype):
+            b = x_shape[0]
+            return (
+                jax.ShapeDtypeStruct((b, self.k_best, x_shape[1]), x_dtype),  # y
+                jax.ShapeDtypeStruct((b, self.k_best, self.n_dims), jnp.int32),
+                jax.ShapeDtypeStruct((b, self.k_best), jnp.bool_),
+                jax.ShapeDtypeStruct((), jnp.int32),  # session id
+            )
+
+        @jax.custom_vjp
+        def dispatch(x, logits_concat):
+            # no-grad primal path (inference): no backward will come, so do
+            # NOT store a session — orphans would evict live training sessions
+            y, idx, mask, _ = io_callback(
+                lambda x, lc: self._host_forward(x, lc, store_session=False),
+                specs(x.shape, x.dtype),
+                x,
+                logits_concat,
+            )
+            return y, idx, mask
+
+        def fwd(x, logits_concat):
+            y, idx, mask, cid = io_callback(
+                lambda x, lc: self._host_forward(x, lc, store_session=True),
+                specs(x.shape, x.dtype),
+                x,
+                logits_concat,
+            )
+            return (y, idx, mask), (cid, x, logits_concat)
+
+        def bwd(residuals, cotangents):
+            cid, x, logits_concat = residuals
+            gy = cotangents[0]  # [B, k, D]; idx/mask are int/bool: no cotangent
+            gx = io_callback(
+                self._host_backward,
+                jax.ShapeDtypeStruct(x.shape, x.dtype),
+                cid,
+                gy,
+            )
+            return gx, jnp.zeros_like(logits_concat)
+
+        dispatch.defvjp(fwd, bwd)
+        return dispatch
+
+    # ---- host side: forward fan-out with k-of-n quorum ----
+
+    def _host_forward(self, x, logits_concat, store_session: bool = True):
+        import time as _time
+
+        t0 = _time.monotonic()
+        x = np.asarray(x)
+        logits_concat = np.asarray(logits_concat)
+        batch = x.shape[0]
+        logits = [
+            logits_concat[:, off : off + g]
+            for off, g in zip(self._grid_offsets, self.grid_size)
+        ]
+        alive = client_loop().run(self.alive_cache.get())
+        alive_uids = sorted(alive)
+        if len(alive_uids) < self.k_min:
+            raise MoEDispatchError(
+                f"only {len(alive_uids)} alive experts under prefix "
+                f"{self.uid_prefix!r}, need k_min={self.k_min}"
+            )
+        sel, coords = select_top_k(logits, alive_uids, self.k_best)  # [B, k']
+        k_eff = sel.shape[1]
+
+        # group rows by chosen expert: expert -> (rows, slots)
+        jobs: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        for j in range(k_eff):
+            for e in np.unique(sel[:, j]):
+                rows = np.nonzero(sel[:, j] == e)[0]
+                if e in jobs:
+                    jobs[e] = (
+                        np.concatenate([jobs[e][0], rows]),
+                        np.concatenate([jobs[e][1], np.full(len(rows), j)]),
+                    )
+                else:
+                    jobs[e] = (rows, np.full(len(rows), j))
+
+        results = client_loop().run(
+            self._quorum_fanout(
+                msg_type="forward",
+                jobs={
+                    alive_uids[e]: (alive[alive_uids[e]], x[rows], rows, slots)
+                    for e, (rows, slots) in jobs.items()
+                },
+                batch=batch,
+                quorum=self.k_min,
+                rpc_timeout=self.forward_timeout,
+            )
+        )
+
+        y = np.zeros((batch, self.k_best, x.shape[1]), x.dtype)
+        mask = np.zeros((batch, self.k_best), bool)
+        idx = np.zeros((batch, self.k_best, self.n_dims), np.int32)
+        idx[:, :k_eff] = coords[sel]
+        session: dict[str, tuple] = {}
+        for uid, (endpoint, x_rows, rows, slots, reply) in results.items():
+            if reply is None:
+                continue
+            y[rows, slots] = np.asarray(reply[0], x.dtype)[: len(rows)]
+            mask[rows, slots] = True
+            session[uid] = (endpoint, x_rows, rows, slots)
+
+        per_sample = mask.sum(axis=1)
+        if (per_sample < self.k_min).any():
+            raise MoEDispatchError(
+                f"quorum failed: {(per_sample < self.k_min).sum()} of {batch} "
+                f"samples got fewer than k_min={self.k_min} expert replies"
+            )
+
+        cid = -1
+        if store_session:
+            cid = next(self._call_counter)
+            with self._sessions_lock:
+                self._sessions[cid] = session
+                while len(self._sessions) > self.max_sessions:
+                    self._sessions.popitem(last=False)
+        self.dispatch_times.append(_time.monotonic() - t0)
+        return y, idx, mask, np.int32(cid)
+
+    # ---- host side: backward fan-out to exactly the responders ----
+
+    def _host_backward(self, cid, gy):
+        gy = np.asarray(gy)
+        with self._sessions_lock:
+            session = self._sessions.pop(int(cid), None)
+        if session is None:
+            raise MoEDispatchError(
+                f"no dispatch session {int(cid)}: backward without forward, "
+                "or session evicted (raise max_sessions?)"
+            )
+        batch = gy.shape[0]
+        jobs = {
+            uid: (endpoint, x_rows, rows, slots)
+            for uid, (endpoint, x_rows, rows, slots) in session.items()
+        }
+        results = client_loop().run(
+            self._quorum_fanout(
+                msg_type="backward",
+                jobs={
+                    uid: (ep, x_rows, rows, slots, gy[rows, slots])
+                    for uid, (ep, x_rows, rows, slots) in jobs.items()
+                },
+                batch=batch,
+                quorum=self.backward_k_min,
+                rpc_timeout=self.backward_timeout,
+            )
+        )
+        gx = np.zeros((batch, gy.shape[-1]), gy.dtype)
+        ok = np.zeros(batch, np.int64)
+        for uid, payload in results.items():
+            reply = payload[-1]
+            if reply is None:
+                continue
+            _, _, rows, slots = session[uid][:4]
+            gx[rows] += np.asarray(reply[0], gy.dtype)[: len(rows)]
+            ok[rows] += 1
+        if (ok < self.backward_k_min).any():
+            raise MoEDispatchError(
+                f"backward quorum failed: {(ok < self.backward_k_min).sum()} "
+                f"samples got fewer than backward_k_min={self.backward_k_min} grads"
+            )
+        return gx
+
+    # ---- the k-of-n gather loop (shared by forward and backward) ----
+
+    async def _quorum_fanout(
+        self, msg_type: str, jobs: dict, batch: int, quorum: int, rpc_timeout: float
+    ) -> dict:
+        """Run all RPCs in parallel; once every sample has ≥ quorum successful
+        replies, wait a grace period then cancel stragglers (the reference's
+        k_min + timeout_after_k_min contract)."""
+        loop = asyncio.get_running_loop()
+        registry = pool_registry()
+
+        async def call(uid, job):
+            if msg_type == "forward":
+                endpoint, x_rows, rows, slots = job
+                tensors, _ = await registry.get(endpoint).rpc(
+                    "forward", [x_rows], {"uid": uid}, timeout=rpc_timeout
+                )
+            else:
+                endpoint, x_rows, rows, slots, grad_rows = job
+                tensors, _ = await registry.get(endpoint).rpc(
+                    "backward",
+                    [x_rows, grad_rows],
+                    {"uid": uid, "n_inputs": 1},
+                    timeout=rpc_timeout,
+                )
+            return tensors
+
+        pending = {
+            asyncio.ensure_future(call(uid, job)): uid for uid, job in jobs.items()
+        }
+        rows_of = {uid: job[2] for uid, job in jobs.items()}
+        per_sample = np.zeros(batch, np.int64)
+        results = {uid: (*job, None) for uid, job in jobs.items()}
+        deadline: Optional[float] = None
+        while pending:
+            timeout = None if deadline is None else max(0.0, deadline - loop.time())
+            done, _ = await asyncio.wait(
+                pending, timeout=timeout, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not done:
+                break  # grace period expired — drop stragglers
+            for task in done:
+                uid = pending.pop(task)
+                try:
+                    tensors = task.result()
+                except Exception as e:
+                    logger.warning("%s RPC to %s failed: %s", msg_type, uid, e)
+                    continue
+                results[uid] = (*jobs[uid], tensors)
+                per_sample[rows_of[uid]] += 1
+            if deadline is None and (per_sample >= quorum).all():
+                deadline = loop.time() + self.timeout_after_k_min
+        for task in pending:
+            task.cancel()
+        return results

@@ -1,0 +1,130 @@
+"""Expert discovery sources + top-k selection for the DMoE client.
+
+The reference client finds alive experts via DHT prefix beam search
+(``first_k_active``-style, ``hivemind/client/moe.py`` — SURVEY.md §2;
+unverifiable refs, mount empty).  This module defines the *source*
+interface both the DHT (M2) and a static in-process table implement, plus
+the batched per-sample top-k scoring used by RemoteMixtureOfExperts.
+
+Expert UIDs are grid-structured: ``{prefix}.{i1}.{i2}...{in}`` for an
+n-dimensional grid (e.g. ``ffn.4.17``), matching the reference's
+multi-dimensional gating.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional, Protocol, Sequence
+
+import numpy as np
+
+from learning_at_home_tpu.utils.connection import Endpoint
+
+UID_DELIMITER = "."
+
+
+def make_uid(prefix: str, coords: Sequence[int]) -> str:
+    return UID_DELIMITER.join([prefix, *map(str, coords)])
+
+
+def split_uid(uid: str) -> tuple[str, tuple[int, ...]]:
+    parts = uid.split(UID_DELIMITER)
+    coords = []
+    while parts and parts[-1].isdigit():
+        coords.append(int(parts.pop()))
+    return UID_DELIMITER.join(parts), tuple(reversed(coords))
+
+
+class ExpertSource(Protocol):
+    """Anything that can enumerate alive experts and resolve endpoints."""
+
+    async def get_alive_experts(
+        self, prefix: str
+    ) -> dict[str, Endpoint]:  # uid -> endpoint
+        ...
+
+    async def first_k_active(
+        self, prefixes: Sequence[str], k: int
+    ) -> dict[str, bool]:
+        """Which of the given uid prefixes have ≥1 alive expert (beam search)."""
+        ...
+
+
+class StaticExpertSource:
+    """Fixed uid→endpoint table (single-host tests, no DHT; [BJ] config 2)."""
+
+    def __init__(self, experts: dict[str, Endpoint]):
+        self.experts = dict(experts)
+
+    @staticmethod
+    def _matches(uid: str, prefix: str) -> bool:
+        # full-component match: prefix "ffn" owns "ffn.3" but not "ffn2.3"
+        return uid == prefix or uid.startswith(prefix + UID_DELIMITER)
+
+    async def get_alive_experts(self, prefix: str) -> dict[str, Endpoint]:
+        return {
+            uid: ep for uid, ep in self.experts.items() if self._matches(uid, prefix)
+        }
+
+    async def first_k_active(self, prefixes, k) -> dict[str, bool]:
+        out = {}
+        for p in prefixes:
+            out[p] = any(self._matches(uid, p) for uid in self.experts)
+        return out
+
+
+class CachedAliveSet:
+    """TTL cache over get_alive_experts — one discovery per window, not per
+    batch (keeps routing off the dispatch hot path)."""
+
+    def __init__(self, source: ExpertSource, prefix: str, ttl: float = 3.0):
+        self.source = source
+        self.prefix = prefix
+        self.ttl = ttl
+        self._cached: Optional[dict[str, Endpoint]] = None
+        self._stamp = 0.0
+
+    async def get(self, force_refresh: bool = False) -> dict[str, Endpoint]:
+        now = time.monotonic()
+        if force_refresh or self._cached is None or now - self._stamp > self.ttl:
+            self._cached = await self.source.get_alive_experts(self.prefix)
+            self._stamp = now
+        return self._cached
+
+
+def score_experts(
+    logits_per_dim: Sequence[np.ndarray], coords: np.ndarray
+) -> np.ndarray:
+    """Batched grid scores: sum of per-dimension gate logits.
+
+    logits_per_dim: list over dims d of [batch, grid_d] arrays.
+    coords: [n_experts, n_dims] integer grid coordinates.
+    Returns [batch, n_experts].
+    """
+    scores = logits_per_dim[0][:, coords[:, 0]]
+    for d in range(1, coords.shape[1]):
+        scores = scores + logits_per_dim[d][:, coords[:, d]]
+    return scores
+
+
+def select_top_k(
+    logits_per_dim: Sequence[np.ndarray],
+    alive_uids: Sequence[str],
+    k: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-sample top-k over all alive experts (full enumeration).
+
+    Exact and vectorized; fine up to ~10^4 alive experts per batch.  The
+    DHT-backed beam search (M2/M4) replaces enumeration when the grid is
+    large but only a fraction is alive or local.
+    Returns (sel [batch, k] indices into alive_uids, coords [n, n_dims]).
+    """
+    coords = np.asarray([split_uid(uid)[1] for uid in alive_uids], dtype=np.int64)
+    scores = score_experts(logits_per_dim, coords)  # [B, E]
+    n = scores.shape[1]
+    k_eff = min(k, n)
+    # argpartition then sort the head: O(E + k log k) per sample
+    part = np.argpartition(-scores, k_eff - 1, axis=1)[:, :k_eff]
+    order = np.take_along_axis(scores, part, axis=1).argsort(axis=1)[:, ::-1]
+    sel = np.take_along_axis(part, order, axis=1)
+    return sel, coords

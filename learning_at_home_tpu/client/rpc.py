@@ -1,0 +1,55 @@
+"""Shared client-side RPC machinery: one background loop + pool registry.
+
+All client stubs (RemoteExpert, RemoteMixtureOfExperts) in a process share a
+single asyncio loop thread and a per-endpoint connection-pool registry —
+the TPU-build replacement for the reference's thread-per-call dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
+from learning_at_home_tpu.utils.connection import PoolRegistry
+
+_lock = threading.Lock()
+_loop: Optional[BackgroundLoop] = None
+_registry: Optional[PoolRegistry] = None
+
+
+def client_loop() -> BackgroundLoop:
+    global _loop
+    with _lock:
+        if _loop is None or _loop._shutdown:
+            _loop = BackgroundLoop(name="lah-client")
+        return _loop
+
+
+def pool_registry() -> PoolRegistry:
+    global _registry
+    with _lock:
+        if _registry is None:
+            _registry = PoolRegistry()
+        return _registry
+
+
+def reset_client_rpc() -> None:
+    """Close all client connections and the loop (test teardown helper)."""
+    global _loop, _registry
+    with _lock:
+        if _registry is not None:
+            registry = _registry
+            _registry = None
+            if _loop is not None and not _loop._shutdown:
+
+                async def _close():
+                    registry.close()
+
+                try:
+                    _loop.run(_close(), timeout=5)
+                except Exception:
+                    pass
+        if _loop is not None:
+            _loop.shutdown()
+            _loop = None

@@ -1,0 +1,15 @@
+from learning_at_home_tpu.models.layers import (
+    FeedforwardBlock,
+    TransformerEncoderBlock,
+    NopBlock,
+    name_to_block,
+    make_expert,
+)
+
+__all__ = [
+    "FeedforwardBlock",
+    "TransformerEncoderBlock",
+    "NopBlock",
+    "name_to_block",
+    "make_expert",
+]

@@ -1,0 +1,89 @@
+"""Expert layer zoo: the sample blocks servers can host, by registry name.
+
+Parity with the reference's ``hivemind/server/layers/`` registry
+(``name_to_block``-style, SURVEY.md §2 "Expert layer zoo"; unverifiable
+refs, mount empty): an FFN block and a Transformer-encoder block, keyed by
+name so CLI/server configs can say ``expert_cls="ffn"``.
+
+TPU notes: blocks are flax modules; matmul-heavy, bias-light shapes that
+tile cleanly onto the MXU.  ``dtype`` controls activation/compute precision
+(bfloat16 by default on TPU); parameters stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FeedforwardBlock(nn.Module):
+    """Residual pre-LN MLP expert: LN → Dense(4h) → GELU → Dense(h) + x."""
+
+    hidden_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(4 * self.hidden_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype)(h)
+        return x + h
+
+
+class TransformerEncoderBlock(nn.Module):
+    """Pre-LN transformer encoder layer expert over [batch, seq, hidden]."""
+
+    hidden_dim: int
+    num_heads: int = 8
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype
+        )(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(4 * self.hidden_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype)(h)
+        return x + h
+
+
+class NopBlock(nn.Module):
+    """Identity expert — used by throughput benchmarks to isolate the
+    batching/transport overhead from compute."""
+
+    hidden_dim: int = 0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # one trainable scalar so backward/optimizer paths stay exercised
+        scale = self.param("scale", nn.initializers.ones, ())
+        return x * scale
+
+
+name_to_block: dict[str, Callable[..., nn.Module]] = {
+    "ffn": FeedforwardBlock,
+    "transformer": TransformerEncoderBlock,
+    "nop": NopBlock,
+}
+
+
+def make_expert(
+    expert_cls: str, hidden_dim: int, rng: jax.Array, sample_input, dtype=jnp.float32
+) -> tuple[Callable, Any]:
+    """Build ``(apply_fn, params)`` for an ExpertBackend from a registry name."""
+    module = name_to_block[expert_cls](hidden_dim=hidden_dim, dtype=dtype)
+    params = module.init(rng, sample_input)
+
+    def apply_fn(params, *inputs):
+        return module.apply(params, *inputs)
+
+    return apply_fn, params

@@ -1,0 +1,5 @@
+from learning_at_home_tpu.server.expert_backend import ExpertBackend
+from learning_at_home_tpu.server.task_pool import TaskPool, BatchJob, bucket_rows
+from learning_at_home_tpu.server.runtime import Runtime
+
+__all__ = ["ExpertBackend", "TaskPool", "BatchJob", "bucket_rows", "Runtime"]

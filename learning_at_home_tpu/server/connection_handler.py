@@ -1,0 +1,93 @@
+"""Server-side RPC dispatch: forward / backward / info over framed TCP.
+
+Contract from the reference's ``hivemind/server/connection_handler.py``
+(SURVEY.md §2; unverifiable refs, mount empty): accept connections, parse
+message type, deserialize tensors, submit to the right expert's pool, await
+the future, reply.  Reference runs one-or-more *processes*; here it is pure
+asyncio on the server's event loop — each connection is a coroutine, and
+the expensive work (XLA execution) happens on the Runtime thread anyway.
+
+Wire protocol (see utils/serialization.py for framing):
+
+- ``forward``:  meta {uid}, tensors [*inputs]            → ``result`` [*outputs]
+- ``backward``: meta {uid, n_inputs}, tensors [*inputs, *grad_outputs]
+                                                          → ``result`` [*input_grads]
+- ``info``:     meta {uid}                                → ``result`` meta=info
+- errors                                                  → ``error`` meta {message}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING
+
+from learning_at_home_tpu.utils.serialization import (
+    pack_message,
+    recv_frame,
+    send_frame,
+    unpack_message,
+)
+
+if TYPE_CHECKING:
+    from learning_at_home_tpu.server.server import Server
+
+logger = logging.getLogger(__name__)
+
+
+class ConnectionHandler:
+    """Dispatches one TCP connection's requests to expert task pools."""
+
+    def __init__(self, server: "Server"):
+        self.server = server
+
+    async def handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    payload = await recv_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                reply = await self._dispatch(payload)
+                await send_frame(writer, reply)
+        except Exception:
+            logger.exception("connection handler failed for peer %s", peer)
+        finally:
+            writer.close()
+
+    async def _dispatch(self, payload: bytes) -> bytes:
+        try:
+            msg_type, tensors, meta = unpack_message(payload)
+        except Exception as e:
+            return pack_message("error", meta={"message": f"malformed request: {e}"})
+        uid = meta.get("uid")
+        backend = self.server.experts.get(uid)
+        if backend is None:
+            return pack_message(
+                "error", meta={"message": f"unknown expert uid: {uid!r}"}
+            )
+        try:
+            if msg_type == "forward":
+                outputs = await self.server.forward_pools[uid].submit_task(*tensors)
+                return pack_message("result", outputs)
+            elif msg_type == "backward":
+                n_inputs = int(meta.get("n_inputs", backend.n_inputs))
+                if n_inputs != backend.n_inputs:
+                    raise ValueError(
+                        f"expert {uid} takes {backend.n_inputs} inputs, "
+                        f"request declared {n_inputs}"
+                    )
+                outputs = await self.server.backward_pools[uid].submit_task(*tensors)
+                return pack_message("result", outputs)
+            elif msg_type == "info":
+                return pack_message("result", meta=backend.get_info())
+            else:
+                return pack_message(
+                    "error", meta={"message": f"unknown message type {msg_type!r}"}
+                )
+        except Exception as e:
+            logger.exception("request %s failed for expert %s", msg_type, uid)
+            return pack_message("error", meta={"message": f"{type(e).__name__}: {e}"})

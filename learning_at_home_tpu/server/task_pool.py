@@ -1,0 +1,186 @@
+"""TaskPool: cross-request dynamic batching with static-shape bucketing.
+
+Contract from the reference's ``hivemind/server/task_pool.py`` (SURVEY.md §2
+[BJ]; unverifiable refs, mount empty): accept per-request tasks, each tied to
+a future; accumulate into batches up to ``max_batch_size``; oldest-first
+priority; hand formed batches to the Runtime and scatter results back.
+
+TPU-native deltas:
+
+- **asyncio, not processes**: tasks arrive on the server's event loop from
+  connection handlers; the pool manager is a coroutine.  XLA dispatch
+  releases the GIL, so process isolation buys nothing here.
+- **Static shapes**: XLA compiles one program per shape.  Arbitrary batch
+  sizes would recompile per request, so formed batches are padded up to a
+  power-of-two row bucket (≤ ``max_batch_size``).  One compile per bucket,
+  amortized forever; padding waste is tracked in :attr:`padded_rows` /
+  :attr:`total_rows` and surfaces in the benchmark metrics (SURVEY.md §7
+  "hard parts").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def bucket_rows(n: int, max_batch_size: int) -> int:
+    """Smallest power-of-two ≥ n, clamped to max_batch_size."""
+    if n >= max_batch_size:
+        return max_batch_size
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+@dataclass(order=True)
+class BatchJob:
+    """One formed batch, queued for the Runtime's device thread."""
+
+    priority: float  # oldest task's arrival time → earliest runs first
+    seq: int
+    pool: "TaskPool" = field(compare=False)
+    inputs: tuple = field(compare=False)  # padded, stacked host arrays
+    row_spans: list = field(compare=False)  # (task_future, start, stop)
+    n_rows: int = field(compare=False)  # real rows before padding
+    formed_at: float = field(compare=False, default=0.0)
+
+
+@dataclass
+class _Task:
+    tensors: tuple
+    future: asyncio.Future
+    arrived: float
+    n_rows: int
+
+
+class TaskPool:
+    """Batches tasks for ONE expert computation (forward OR backward).
+
+    ``process_fn(inputs) -> list[np.ndarray]`` runs on the Runtime thread.
+    """
+
+    _seq = itertools.count()
+
+    def __init__(
+        self,
+        process_fn: Callable[[Sequence[np.ndarray]], Sequence[Any]],
+        name: str,
+        max_batch_size: int = 1024,
+        batch_timeout: float = 0.002,
+        pad_buckets: bool = True,
+    ):
+        self.process_fn = process_fn
+        self.name = name
+        self.max_batch_size = max_batch_size
+        self.batch_timeout = batch_timeout
+        self.pad_buckets = pad_buckets
+        self._tasks: asyncio.Queue[_Task] = asyncio.Queue()
+        self._carry: Optional[_Task] = None  # oldest task that didn't fit
+        self._manager_task: Optional[asyncio.Task] = None
+        # padding-waste + latency telemetry (north-star metric plumbing)
+        self.total_rows = 0
+        self.padded_rows = 0
+        self.batches_formed = 0
+
+    async def submit_task(self, *tensors: np.ndarray) -> list[np.ndarray]:
+        """Submit one task (row-batch of tensors); await its outputs."""
+        n_rows = int(tensors[0].shape[0])
+        if n_rows > self.max_batch_size:
+            raise ValueError(
+                f"task of {n_rows} rows exceeds max_batch_size="
+                f"{self.max_batch_size} for pool {self.name}"
+            )
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._tasks.put(_Task(tuple(tensors), future, time.monotonic(), n_rows))
+        return await future
+
+    def start(self, runtime) -> None:
+        """Begin forming batches and feeding them to ``runtime``."""
+        self._manager_task = asyncio.get_running_loop().create_task(
+            self._manager(runtime), name=f"pool-manager-{self.name}"
+        )
+
+    def shutdown(self) -> None:
+        if self._manager_task is not None:
+            self._manager_task.cancel()
+
+    async def _manager(self, runtime) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                first = await self._tasks.get()
+            batch = [first]
+            rows = first.n_rows
+            deadline = loop.time() + self.batch_timeout
+            # Greedily absorb concurrent tasks until the bucket is full or
+            # the grace window closes — this is the cross-request batching.
+            while rows < self.max_batch_size:
+                remaining = deadline - loop.time()
+                try:
+                    if remaining <= 0:
+                        task = self._tasks.get_nowait()
+                    else:
+                        task = await asyncio.wait_for(self._tasks.get(), remaining)
+                except (asyncio.TimeoutError, asyncio.QueueEmpty):
+                    break
+                if rows + task.n_rows > self.max_batch_size:
+                    # doesn't fit: hold it as the HEAD of the next batch so
+                    # oldest-first ordering survives (re-enqueueing would send
+                    # it behind newer arrivals → starvation of large tasks)
+                    self._carry = task
+                    break
+                batch.append(task)
+                rows += task.n_rows
+            self._dispatch(batch, rows, runtime)
+
+    def _dispatch(self, batch: list[_Task], rows: int, runtime) -> None:
+        target = bucket_rows(rows, self.max_batch_size) if self.pad_buckets else rows
+        stacked = []
+        for i in range(len(batch[0].tensors)):
+            parts = [t.tensors[i] for t in batch]
+            arr = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+            if target > rows:
+                pad = np.zeros((target - rows, *arr.shape[1:]), dtype=arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            stacked.append(arr)
+        spans, start = [], 0
+        for t in batch:
+            spans.append((t.future, start, start + t.n_rows))
+            start += t.n_rows
+        self.total_rows += rows
+        self.padded_rows += target - rows
+        self.batches_formed += 1
+        job = BatchJob(
+            priority=batch[0].arrived,
+            seq=next(self._seq),
+            pool=self,
+            inputs=tuple(stacked),
+            row_spans=spans,
+            n_rows=rows,
+            formed_at=time.monotonic(),
+        )
+        runtime.submit(job)
+
+    # called back on the event loop by the Runtime after device execution
+    def deliver(self, job: BatchJob, outputs, error: Optional[BaseException]) -> None:
+        for future, start, stop in job.row_spans:
+            if future.cancelled():
+                continue
+            if error is not None:
+                future.set_exception(error)
+            else:
+                future.set_result([np.asarray(o[start:stop]) for o in outputs])
+
+    @property
+    def padding_waste(self) -> float:
+        total = self.total_rows + self.padded_rows
+        return self.padded_rows / total if total else 0.0

@@ -75,7 +75,9 @@ class BackgroundLoop:
         def _stop() -> None:
             for task in asyncio.all_tasks(self.loop):
                 task.cancel()
-            self.loop.stop()
+            # cancellations are delivered on the next loop pass; stop after
+            # that pass so coroutines get to run their cleanup (finally:)
+            self.loop.call_soon(self.loop.stop)
 
         if self.loop.is_running():
             self.loop.call_soon_threadsafe(_stop)

@@ -1,18 +1,29 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before the first ``import jax`` anywhere in the test process so the
-multi-device sharding paths (all_to_all expert dispatch, pjit) are exercised
-without TPU hardware — SURVEY.md §4 "TPU-build implication".
+The sandbox's sitecustomize imports jax and registers the axon TPU PJRT
+plugin in EVERY interpreter, with ``JAX_PLATFORMS=axon`` preset in the
+environment — so by the time this conftest runs, jax is already imported and
+env-var edits alone are ineffective.  We therefore both set the env vars
+(for any subprocesses we spawn) and update the live jax config (for this
+process).  CPU is required here: the client tests use host callbacks
+(``io_callback``), which the axon plugin does not implement, and the
+multi-device sharding tests need the 8 virtual CPU devices
+(SURVEY.md §4 "TPU-build implication").
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon register() in subprocesses
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (already imported by sitecustomize; config still mutable)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

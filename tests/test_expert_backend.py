@@ -1,0 +1,114 @@
+"""M0 tests (BASELINE config 1): single FFN ExpertBackend fwd/bwd, no DHT.
+
+Checks the core contract: backward returns input-grads identical to local
+autodiff AND immediately applies the optimizer step (async SGD)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_at_home_tpu.models import make_expert
+from learning_at_home_tpu.server import ExpertBackend
+
+HID = 64
+
+
+@pytest.fixture
+def backend():
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((2, HID))
+    apply_fn, params = make_expert("ffn", HID, rng, sample)
+    return ExpertBackend(
+        "ffn.0", apply_fn, params, optax.sgd(0.05), max_batch_size=256
+    )
+
+
+def test_forward_matches_local(backend):
+    x = np.random.RandomState(1).randn(8, HID).astype(np.float32)
+    (out,) = backend.forward([x])
+    expected = backend.apply_fn(backend.params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_backward_grads_and_update(backend):
+    rs = np.random.RandomState(2)
+    x = rs.randn(8, HID).astype(np.float32)
+    g = rs.randn(8, HID).astype(np.float32)
+
+    params_before = jax.tree_util.tree_map(np.asarray, backend.params)
+    # expected: plain jax.vjp against the pre-update params
+    _, vjp_fn = jax.vjp(lambda p, xs: backend.apply_fn(p, xs), backend.params, x)
+    expected_pgrads, expected_xgrad = vjp_fn(g)
+
+    (xgrad,) = backend.backward([x], [g])
+    np.testing.assert_allclose(
+        np.asarray(xgrad), np.asarray(expected_xgrad), atol=1e-4, rtol=1e-4
+    )
+
+    # async SGD: params must have moved by -lr * grad immediately
+    params_after = jax.tree_util.tree_map(np.asarray, backend.params)
+    moved = jax.tree_util.tree_map(
+        lambda before, after, grad: np.allclose(
+            after, before - 0.05 * np.asarray(grad), atol=1e-4
+        ),
+        params_before,
+        params_after,
+        expected_pgrads,
+    )
+    assert all(jax.tree_util.tree_leaves(moved))
+    assert backend.update_count == 1
+
+
+def test_zero_padding_rows_do_not_corrupt_update(backend):
+    """Padded (zero grad_output) rows must not change the param update."""
+    rs = np.random.RandomState(3)
+    x = rs.randn(4, HID).astype(np.float32)
+    g = rs.randn(4, HID).astype(np.float32)
+
+    x_pad = np.concatenate([x, rs.randn(4, HID).astype(np.float32)], axis=0)
+    g_pad = np.concatenate([g, np.zeros((4, HID), np.float32)], axis=0)
+
+    import copy
+
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((2, HID))
+    from learning_at_home_tpu.models import make_expert as mk
+
+    apply_fn, params = mk("ffn", HID, rng, sample)
+    twin = ExpertBackend("twin", apply_fn, params, optax.sgd(0.05))
+
+    (grad_a,) = backend.backward([x], [g])
+    (grad_b_full,) = twin.backward([x_pad], [g_pad])
+
+    np.testing.assert_allclose(
+        np.asarray(grad_a), np.asarray(grad_b_full)[:4], atol=1e-4, rtol=1e-4
+    )
+    a = jax.tree_util.tree_leaves(jax.tree_util.tree_map(np.asarray, backend.params))
+    b = jax.tree_util.tree_leaves(jax.tree_util.tree_map(np.asarray, twin.params))
+    for pa, pb in zip(a, b):
+        np.testing.assert_allclose(pa, pb, atol=1e-4, rtol=1e-4)
+
+
+def test_get_info(backend):
+    info = backend.get_info()
+    assert info["name"] == "ffn.0"
+    assert info["num_params"] > 8 * HID * HID
+    assert info["update_count"] == 0
+
+
+def test_state_dict_roundtrip(backend):
+    x = np.random.RandomState(5).randn(4, HID).astype(np.float32)
+    g = np.ones((4, HID), np.float32)
+    backend.backward([x], [g])
+    snap = backend.state_dict()
+
+    rng = jax.random.PRNGKey(9)
+    apply_fn, params = make_expert("ffn", HID, rng, jnp.zeros((2, HID)))
+    fresh = ExpertBackend("ffn.0", apply_fn, params, optax.sgd(0.05))
+    fresh.load_state_dict(snap)
+    (a,) = backend.forward([x])
+    (b,) = fresh.forward([x])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert fresh.update_count == 1

@@ -1,0 +1,200 @@
+"""M1 tests (BASELINE config 2): RemoteMixtureOfExperts, 4 FFN experts,
+top-2 gating, single host — plus the k-of-n fault-tolerance path."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_at_home_tpu.client import reset_client_rpc
+from learning_at_home_tpu.client.moe import MoEDispatchError, RemoteMixtureOfExperts
+from learning_at_home_tpu.client.routing import (
+    StaticExpertSource,
+    make_uid,
+    select_top_k,
+    split_uid,
+)
+from learning_at_home_tpu.server.server import background_server
+
+HID = 16
+
+
+def test_uid_helpers():
+    assert make_uid("ffn", (4, 17)) == "ffn.4.17"
+    assert split_uid("ffn.4.17") == ("ffn", (4, 17))
+    assert split_uid("expert.3") == ("expert", (3,))
+
+
+def test_select_top_k():
+    rs = np.random.RandomState(0)
+    logits = [rs.randn(5, 4).astype(np.float32), rs.randn(5, 3).astype(np.float32)]
+    uids = [make_uid("g", (i, j)) for i in range(4) for j in range(3)]
+    sel, coords = select_top_k(logits, uids, k=3)
+    assert sel.shape == (5, 3)
+    # brute force check
+    for b in range(5):
+        scores = np.array([logits[0][b, i] + logits[1][b, j] for i, j in coords])
+        best = np.argsort(-scores)[:3]
+        np.testing.assert_array_equal(np.sort(scores[sel[b]]), np.sort(scores[best]))
+        assert scores[sel[b, 0]] >= scores[sel[b, 1]] >= scores[sel[b, 2]]
+
+
+@pytest.fixture(scope="module")
+def moe_server():
+    with background_server(
+        num_experts=4, hidden_dim=HID, expert_prefix="ffn", seed=7
+    ) as (endpoint, srv):
+        source = StaticExpertSource({uid: endpoint for uid in srv.experts})
+        yield endpoint, srv, source
+    reset_client_rpc()
+
+
+def _local_outputs(srv, x):
+    """Each expert's output under its live server-side params."""
+    outs = {}
+    for uid, backend in srv.experts.items():
+        params = backend.state_dict()["params"]
+        outs[uid] = np.asarray(backend.apply_fn(params, x))
+    return outs
+
+
+def test_moe_forward_full_mixture(moe_server):
+    """k_best = all experts: output must equal the full softmax mixture."""
+    endpoint, srv, source = moe_server
+    moe = RemoteMixtureOfExperts(
+        in_features=HID, grid_size=(4,), uid_prefix="ffn", source=source,
+        k_best=4, k_min=4,
+    )
+    gate = moe.init_gate_params(jax.random.PRNGKey(0))
+    x = np.random.RandomState(1).randn(6, HID).astype(np.float32)
+
+    local = _local_outputs(srv, x)
+    out = np.asarray(moe(jnp.asarray(x), gate))
+
+    logits = np.concatenate(
+        [np.asarray(x @ gate["w0"])], axis=1
+    )  # [6, 4], one grid dim
+    w = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    expected = np.zeros_like(x)
+    for i in range(4):
+        expected += np.asarray(w[:, i : i + 1]) * local[f"ffn.{i}"]
+    np.testing.assert_allclose(out, expected, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_top2_selects_best(moe_server):
+    endpoint, srv, source = moe_server
+    moe = RemoteMixtureOfExperts(
+        in_features=HID, grid_size=(4,), uid_prefix="ffn", source=source,
+        k_best=2, k_min=2,
+    )
+    gate = moe.init_gate_params(jax.random.PRNGKey(3))
+    x = np.random.RandomState(2).randn(5, HID).astype(np.float32)
+    local = _local_outputs(srv, x)
+
+    out = np.asarray(moe(jnp.asarray(x), gate))
+
+    logits = np.asarray(x @ np.asarray(gate["w0"]))  # [5, 4]
+    expected = np.zeros_like(x)
+    for b in range(5):
+        top2 = np.argsort(-logits[b])[:2]
+        w = jax.nn.softmax(jnp.asarray(logits[b, top2]))
+        for wi, i in zip(np.asarray(w), top2):
+            expected[b] += wi * local[f"ffn.{i}"][b]
+    np.testing.assert_allclose(out, expected, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_grad_flows_and_updates_experts(moe_server):
+    endpoint, srv, source = moe_server
+    moe = RemoteMixtureOfExperts(
+        in_features=HID, grid_size=(4,), uid_prefix="ffn", source=source,
+        k_best=4, k_min=4, backward_k_min=1,
+    )
+    gate = moe.init_gate_params(jax.random.PRNGKey(5))
+    x = jnp.asarray(np.random.RandomState(3).randn(4, HID).astype(np.float32))
+
+    updates_before = {uid: b.update_count for uid, b in srv.experts.items()}
+
+    def loss(gate, x):
+        return jnp.sum(moe(x, gate) ** 2)
+
+    ggate, gx = jax.grad(loss, argnums=(0, 1))(gate, x)
+    # gate grads are nonzero (gradient through softmax-weighted mixture)
+    assert float(jnp.abs(ggate["w0"]).sum()) > 0
+    # x grads are nonzero (gradient through the backward RPCs)
+    assert float(jnp.abs(gx).sum()) > 0
+    # every expert participated → every expert applied its async update
+    for uid, b in srv.experts.items():
+        assert b.update_count == updates_before[uid] + 1, uid
+
+
+def test_moe_under_jit(moe_server):
+    endpoint, srv, source = moe_server
+    moe = RemoteMixtureOfExperts(
+        in_features=HID, grid_size=(4,), uid_prefix="ffn", source=source,
+        k_best=2, k_min=1,
+    )
+    gate = moe.init_gate_params(jax.random.PRNGKey(6))
+
+    @jax.jit
+    def step(x, gate):
+        return moe(x, gate).sum()
+
+    x = jnp.ones((3, HID), jnp.float32)
+    v1 = step(x, gate)
+    v2 = step(x, gate)  # compiled-cache path
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+
+
+def test_moe_fault_tolerance_dead_server():
+    """One of two servers dies; k_min=1 dispatch must still return."""
+    with background_server(
+        num_experts=2, hidden_dim=HID, expert_prefix="ffn", seed=11
+    ) as (ep_alive, srv_alive):
+        with background_server(
+            num_experts=2, hidden_dim=HID, expert_prefix="dead", seed=12
+        ) as (ep_dead, _):
+            pass  # exits immediately → server is down, port is stale
+        source = StaticExpertSource(
+            {
+                "ffn.0": ep_alive,
+                "ffn.1": ep_alive,
+                # grid coords 2,3 point at the dead endpoint
+                "ffn.2": ep_dead,
+                "ffn.3": ep_dead,
+            }
+        )
+        moe = RemoteMixtureOfExperts(
+            in_features=HID, grid_size=(4,), uid_prefix="ffn", source=source,
+            k_best=4, k_min=1, timeout_after_k_min=0.2, forward_timeout=2.0,
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        x = np.random.RandomState(5).randn(3, HID).astype(np.float32)
+        out = np.asarray(moe(jnp.asarray(x), gate))
+        # mixture must equal softmax over the ALIVE experts only
+        local = _local_outputs(srv_alive, x)
+        logits = np.asarray(x @ np.asarray(gate["w0"]))[:, :2]  # alive coords 0,1
+        w = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        expected = w[:, 0:1] * local["ffn.0"] + w[:, 1:2] * local["ffn.1"]
+        np.testing.assert_allclose(out, expected, atol=1e-4, rtol=1e-4)
+    reset_client_rpc()
+
+
+def test_moe_quorum_failure_raises():
+    """All experts dead → MoEDispatchError, not a hang or silent zero."""
+    with background_server(num_experts=1, hidden_dim=HID, expert_prefix="x") as (
+        ep,
+        _,
+    ):
+        pass  # dead now
+    source = StaticExpertSource({"x.0": ep})
+    moe = RemoteMixtureOfExperts(
+        in_features=HID, grid_size=(1,), uid_prefix="x", source=source,
+        k_best=1, k_min=1, forward_timeout=1.5,
+    )
+    gate = moe.init_gate_params(jax.random.PRNGKey(0))
+    with pytest.raises(Exception):  # XLA wraps the MoEDispatchError
+        np.asarray(moe(jnp.ones((2, HID), jnp.float32), gate))
+    reset_client_rpc()
