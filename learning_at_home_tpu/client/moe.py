@@ -42,6 +42,7 @@ from jax.experimental import io_callback
 from learning_at_home_tpu.client.routing import (
     CachedAliveSet,
     ExpertSource,
+    filter_valid_uids,
     make_uid,
     select_top_k,
 )
@@ -202,7 +203,9 @@ class RemoteMixtureOfExperts:
             for off, g in zip(self._grid_offsets, self.grid_size)
         ]
         alive = client_loop().run(self.alive_cache.get())
-        alive_uids = sorted(alive)
+        alive_uids = sorted(
+            filter_valid_uids(alive, self.uid_prefix, self.grid_size)
+        )
         if len(alive_uids) < self.k_min:
             raise MoEDispatchError(
                 f"only {len(alive_uids)} alive experts under prefix "
@@ -278,16 +281,12 @@ class RemoteMixtureOfExperts:
                 "or session evicted (raise max_sessions?)"
             )
         batch = gy.shape[0]
-        jobs = {
-            uid: (endpoint, x_rows, rows, slots)
-            for uid, (endpoint, x_rows, rows, slots) in session.items()
-        }
         results = client_loop().run(
             self._quorum_fanout(
                 msg_type="backward",
                 jobs={
                     uid: (ep, x_rows, rows, slots, gy[rows, slots])
-                    for uid, (ep, x_rows, rows, slots) in jobs.items()
+                    for uid, (ep, x_rows, rows, slots) in session.items()
                 },
                 batch=batch,
                 quorum=self.backward_k_min,

@@ -27,12 +27,43 @@ def make_uid(prefix: str, coords: Sequence[int]) -> str:
     return UID_DELIMITER.join([prefix, *map(str, coords)])
 
 
-def split_uid(uid: str) -> tuple[str, tuple[int, ...]]:
+def split_uid(uid: str, n_dims: Optional[int] = None) -> tuple[str, tuple[int, ...]]:
+    """Split a grid uid into (prefix, coords).
+
+    With ``n_dims`` given, exactly the last n_dims components are coords —
+    required when the prefix itself may contain numeric segments (e.g.
+    ``block.3.1.2`` with prefix ``block.3``).  Without it, all trailing
+    numeric components are treated as coords (greedy; fine for display).
+    """
     parts = uid.split(UID_DELIMITER)
-    coords = []
+    if n_dims is not None:
+        if len(parts) <= n_dims or not all(p.isdigit() for p in parts[-n_dims:]):
+            raise ValueError(f"uid {uid!r} does not end in {n_dims} grid coords")
+        coords = tuple(int(p) for p in parts[-n_dims:])
+        return UID_DELIMITER.join(parts[:-n_dims]), coords
+    coords_rev = []
     while parts and parts[-1].isdigit():
-        coords.append(int(parts.pop()))
-    return UID_DELIMITER.join(parts), tuple(reversed(coords))
+        coords_rev.append(int(parts.pop()))
+    return UID_DELIMITER.join(parts), tuple(reversed(coords_rev))
+
+
+def filter_valid_uids(
+    uids: Iterable[str], prefix: str, grid_size: Sequence[int]
+) -> list[str]:
+    """Keep only uids of the exact form prefix.c1...cn with coords in-grid.
+
+    DHT alive-sets are peer-supplied; a malformed or out-of-range uid must
+    not crash routing (IndexError in score_experts) or skew selection."""
+    out = []
+    n_dims = len(grid_size)
+    for uid in uids:
+        try:
+            p, coords = split_uid(uid, n_dims)
+        except ValueError:
+            continue
+        if p == prefix and all(0 <= c < g for c, g in zip(coords, grid_size)):
+            out.append(uid)
+    return out
 
 
 class ExpertSource(Protocol):
@@ -119,7 +150,10 @@ def select_top_k(
     large but only a fraction is alive or local.
     Returns (sel [batch, k] indices into alive_uids, coords [n, n_dims]).
     """
-    coords = np.asarray([split_uid(uid)[1] for uid in alive_uids], dtype=np.int64)
+    n_dims = len(logits_per_dim)
+    coords = np.asarray(
+        [split_uid(uid, n_dims)[1] for uid in alive_uids], dtype=np.int64
+    )
     scores = score_experts(logits_per_dim, coords)  # [B, E]
     n = scores.shape[1]
     k_eff = min(k, n)

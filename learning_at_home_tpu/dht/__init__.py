@@ -1,0 +1,180 @@
+"""DHT facade: expert declaration, discovery, and beam-search queries.
+
+Contract from the reference's ``hivemind/dht/__init__.py`` (SURVEY.md §2
+[BJ]; unverifiable refs, mount empty): a DHT handle owning a Kademlia node
+in its own execution domain, exposing ``declare_experts`` /
+``get_experts`` / ``first_k_active``.  The reference isolates the node in a
+separate *process* bridged by mp.Pipe; here the node lives on a dedicated
+asyncio thread (BackgroundLoop) — the async API is callable from ANY loop
+or thread, and sync wrappers serve scripts.
+
+Expert-record layout (powers both enumeration and prefix beam search):
+
+- full record:   key = uid ("ffn.4.17"),       subkey = "" → [host, port]
+- prefix record: key = each uid prefix ("ffn", "ffn.4"), subkey = uid
+                 → [host, port]
+
+All records share one expiration; servers re-declare every
+``update_period`` (heartbeat), so expiry = failure detection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional, Sequence
+
+from learning_at_home_tpu.dht.node import DHTNode
+from learning_at_home_tpu.dht.routing import DHTID, Endpoint
+from learning_at_home_tpu.dht.protocol import PLAIN_SUBKEY
+from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
+from learning_at_home_tpu.utils.timed_storage import get_dht_time
+from learning_at_home_tpu.client.routing import UID_DELIMITER, split_uid
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DHT", "DHTNode", "DHTID"]
+
+
+def uid_prefixes(uid: str) -> list[str]:
+    """All proper prefixes of a grid uid: 'ffn.4.17' → ['ffn', 'ffn.4']."""
+    prefix, coords = split_uid(uid)
+    out = [prefix]
+    for c in coords[:-1]:
+        prefix = f"{prefix}{UID_DELIMITER}{c}"
+        out.append(prefix)
+    return out
+
+
+class DHT:
+    """Synchronous-friendly handle to a Kademlia node on its own loop thread.
+
+    Implements the client's ExpertSource protocol (get_alive_experts /
+    first_k_active), so it can be passed directly to
+    RemoteMixtureOfExperts(source=dht) and to Server(dht=dht).
+    """
+
+    def __init__(
+        self,
+        initial_peers: Sequence[Endpoint] = (),
+        host: str = "127.0.0.1",
+        port: int = 0,
+        **node_kwargs,
+    ):
+        self._loop = BackgroundLoop(name="lah-dht")
+        self.node: DHTNode = self._loop.run(
+            DHTNode.create(host=host, port=port, initial_peers=initial_peers, **node_kwargs),
+            timeout=30,
+        )
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return self.node.endpoint
+
+    def shutdown(self) -> None:
+        try:
+            self._loop.run(self.node.shutdown(), timeout=5)
+        except Exception:
+            pass
+        self._loop.shutdown()
+
+    # ---- loop bridging: async API usable from any thread/loop ----
+
+    async def _bridge(self, coro):
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop.loop:
+            return await coro
+        return await asyncio.wrap_future(self._loop.submit(coro))
+
+    # ---- expert API (async, loop-agnostic) ----
+
+    async def declare_experts(
+        self,
+        uids: Sequence[str],
+        endpoint: Endpoint,
+        expiration: float = 60.0,
+    ) -> int:
+        return await self._bridge(self._declare(uids, endpoint, expiration))
+
+    async def _declare(self, uids, endpoint, expiration) -> int:
+        """Returns how many of ``uids`` had their full record stored."""
+        expires_at = get_dht_time() + expiration
+        value = [endpoint[0], int(endpoint[1])]
+        full = await asyncio.gather(
+            *(self.node.store(uid, value, expires_at) for uid in uids)
+        )
+        await asyncio.gather(
+            *(
+                self.node.store(prefix, value, expires_at, subkey=uid)
+                for uid in uids
+                for prefix in uid_prefixes(uid)
+            )
+        )
+        return sum(bool(r) for r in full)
+
+    async def get_experts(
+        self, uids: Sequence[str]
+    ) -> dict[str, Optional[Endpoint]]:
+        return await self._bridge(self._get_experts(uids))
+
+    async def _get_experts(self, uids) -> dict[str, Optional[Endpoint]]:
+        records = await asyncio.gather(*(self.node.get(uid) for uid in uids))
+        out: dict[str, Optional[Endpoint]] = {}
+        for uid, rec in zip(uids, records):
+            entry = rec.get(PLAIN_SUBKEY)
+            out[uid] = (entry[0][0], int(entry[0][1])) if entry else None
+        return out
+
+    # ---- ExpertSource protocol (used by RemoteMixtureOfExperts) ----
+
+    async def get_alive_experts(self, prefix: str) -> dict[str, Endpoint]:
+        return await self._bridge(self._get_alive(prefix))
+
+    async def _get_alive(self, prefix: str) -> dict[str, Endpoint]:
+        records = await self.node.get(prefix)
+        return {
+            uid: (v[0], int(v[1]))
+            for uid, (v, _) in records.items()
+            if uid != PLAIN_SUBKEY
+        }
+
+    async def first_k_active(
+        self, prefixes: Sequence[str], k: int
+    ) -> dict[str, bool]:
+        """Which prefixes have ≥1 alive expert — the beam-search primitive.
+
+        Queries run in parallel; the result preserves the caller's order
+        (callers pass prefixes sorted by descending gate score)."""
+        return await self._bridge(self._first_k_active(prefixes, k))
+
+    async def _first_k_active(self, prefixes, k) -> dict[str, bool]:
+        records = await asyncio.gather(*(self.node.get(p) for p in prefixes))
+        out = {}
+        active = 0
+        for p, rec in zip(prefixes, records):
+            alive = any(sk != PLAIN_SUBKEY for sk in rec)
+            out[p] = alive
+            active += alive
+            if active >= k:
+                break
+        return out
+
+    # ---- sync conveniences for scripts/tests ----
+
+    def declare_experts_sync(self, uids, endpoint, expiration: float = 60.0) -> int:
+        return self._loop.run(self._declare(uids, endpoint, expiration), timeout=60)
+
+    def get_experts_sync(self, uids) -> dict[str, Optional[Endpoint]]:
+        return self._loop.run(self._get_experts(uids), timeout=60)
+
+    def store_sync(self, key, value, expiration_delta: float, subkey: str = PLAIN_SUBKEY) -> bool:
+        return self._loop.run(
+            self.node.store(key, value, get_dht_time() + expiration_delta, subkey),
+            timeout=60,
+        )
+
+    def get_sync(self, key) -> dict:
+        return self._loop.run(self.node.get(key), timeout=60)

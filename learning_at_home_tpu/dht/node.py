@@ -1,0 +1,176 @@
+"""DHTNode: iterative Kademlia lookups over the TCP protocol layer.
+
+Contract from the reference's ``hivemind/dht/node.py`` (SURVEY.md §2 [BJ];
+unverifiable refs, mount empty): α-parallel iterative ``find_node`` /
+``find_value`` walking k-buckets toward the target; ``store`` writes
+(value, expiration) onto the k closest nodes; reads ignore expired values —
+expiry plus periodic re-declare IS the failure detector (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Iterable, Optional, Sequence
+
+from learning_at_home_tpu.dht.protocol import (
+    DHTProtocol,
+    DHTRecordStorage,
+    PLAIN_SUBKEY,
+)
+from learning_at_home_tpu.dht.routing import DHTID, Endpoint, RoutingTable
+from learning_at_home_tpu.utils.timed_storage import DHTExpiration, get_dht_time
+
+logger = logging.getLogger(__name__)
+
+
+class DHTNode:
+    """One Kademlia peer (asyncio; lives on whichever loop created it)."""
+
+    def __init__(
+        self,
+        node_id: Optional[DHTID] = None,
+        bucket_size: int = 20,
+        alpha: int = 3,
+        rpc_timeout: float = 3.0,
+        max_records: Optional[int] = None,
+    ):
+        self.node_id = node_id if node_id is not None else DHTID.generate()
+        self.alpha = alpha
+        self.bucket_size = bucket_size
+        self.routing_table = RoutingTable(self.node_id, bucket_size)
+        self.storage = DHTRecordStorage(max_records)
+        self.protocol = DHTProtocol(
+            self.node_id, self.routing_table, self.storage, rpc_timeout
+        )
+
+    @classmethod
+    async def create(
+        cls,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        initial_peers: Sequence[Endpoint] = (),
+        **kwargs,
+    ) -> "DHTNode":
+        node = cls(**kwargs)
+        await node.protocol.listen(host, port)
+        if initial_peers:
+            await node.bootstrap(initial_peers)
+        return node
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return ("127.0.0.1", self.protocol.listen_port)
+
+    async def bootstrap(self, initial_peers: Iterable[Endpoint]) -> None:
+        pings = await asyncio.gather(
+            *(self.protocol.call_ping(ep) for ep in initial_peers)
+        )
+        if not any(p is not None for p in pings):
+            logger.warning("bootstrap: no initial peer responded")
+            return
+        # populate buckets around our own ID
+        await self.find_nearest_nodes(self.node_id)
+
+    async def shutdown(self) -> None:
+        await self.protocol.shutdown()
+
+    # ---------------- iterative lookup core ----------------
+
+    async def _iterative_lookup(
+        self, target: DHTID, find_value: bool
+    ) -> tuple[dict[str, tuple[Any, DHTExpiration]], list[tuple[DHTID, Endpoint]]]:
+        key_bytes = target.to_bytes()
+        shortlist: dict[DHTID, Endpoint] = dict(
+            self.routing_table.nearest_neighbors(target, self.bucket_size)
+        )
+        queried: set[DHTID] = set()
+        responded: dict[DHTID, Endpoint] = {}
+        records: dict[str, tuple[Any, DHTExpiration]] = {}
+
+        def merge_records(new: dict[str, tuple[Any, DHTExpiration]]) -> None:
+            for sk, (v, e) in new.items():
+                if sk not in records or records[sk][1] < e:
+                    records[sk] = (v, e)
+
+        while True:
+            candidates = sorted(
+                (nid for nid in shortlist if nid not in queried),
+                key=lambda nid: int(nid) ^ int(target),
+            )[: self.alpha]
+            if not candidates:
+                break
+            queried.update(candidates)
+            calls = [
+                self.protocol.call_find_value(shortlist[nid], key_bytes)
+                if find_value
+                else self.protocol.call_find_node(shortlist[nid], key_bytes)
+                for nid in candidates
+            ]
+            replies = await asyncio.gather(*calls)
+            for nid, reply in zip(candidates, replies):
+                if reply is None:
+                    self.routing_table.remove_node(nid)
+                    continue
+                responded[nid] = shortlist[nid]
+                if find_value:
+                    value_records, peers = reply
+                    merge_records(value_records)
+                else:
+                    peers = reply
+                for peer_id, peer_ep in peers:
+                    if peer_id != self.node_id:
+                        shortlist.setdefault(peer_id, peer_ep)
+            # termination: the k closest known are all queried
+            closest = sorted(shortlist, key=lambda nid: int(nid) ^ int(target))[
+                : self.bucket_size
+            ]
+            if all(nid in queried for nid in closest):
+                break
+
+        nearest = sorted(responded.items(), key=lambda kv: int(kv[0]) ^ int(target))
+        return records, nearest[: self.bucket_size]
+
+    async def find_nearest_nodes(
+        self, target: DHTID
+    ) -> list[tuple[DHTID, Endpoint]]:
+        _, nearest = await self._iterative_lookup(target, find_value=False)
+        return nearest
+
+    # ---------------- public store / get ----------------
+
+    async def store(
+        self,
+        key: str | bytes,
+        value: Any,
+        expiration: DHTExpiration,
+        subkey: str = PLAIN_SUBKEY,
+    ) -> bool:
+        """Write (subkey → value, expiration) onto the k closest nodes."""
+        target = DHTID.from_key(key)
+        nearest = await self.find_nearest_nodes(target)
+        item = (target.to_bytes(), subkey, value, expiration)
+        results = await asyncio.gather(
+            *(self.protocol.call_store(ep, [item]) for _, ep in nearest)
+        )
+        stored_remote = sum(r is not None and r.get(subkey, False) for r in results)
+        # replicate locally when we are within the k closest (or swarm is tiny)
+        if len(nearest) < self.bucket_size or any(
+            int(self.node_id) ^ int(target) < int(nid) ^ int(target)
+            for nid, _ in nearest
+        ):
+            self.storage.store(target.to_bytes(), subkey, value, expiration)
+            stored_remote += 1
+        return stored_remote > 0
+
+    async def get(
+        self, key: str | bytes
+    ) -> dict[str, tuple[Any, DHTExpiration]]:
+        """Merged fresh records for key (freshest expiration wins per subkey)."""
+        target = DHTID.from_key(key)
+        records, _ = await self._iterative_lookup(target, find_value=True)
+        now = get_dht_time()
+        for sk, (v, e) in self.storage.get(target.to_bytes()).items():
+            if e > now and (sk not in records or records[sk][1] < e):
+                records[sk] = (v, e)
+        return records

@@ -66,6 +66,8 @@ class Runtime:
             item = self._queue.get()
             _, _, job = item
             if job is None or self._stop.is_set():
+                if job is not None:
+                    self._deliver(job, None, RuntimeError("runtime shut down"))
                 break
             started = time.monotonic()
             self.queue_time += started - job.formed_at
@@ -82,7 +84,25 @@ class Runtime:
                 error = e
             self.device_time += time.monotonic() - started
             self.jobs_processed += 1
+            self._deliver(job, outputs, error)
+        self._drain_remaining()
+
+    def _deliver(self, job: BatchJob, outputs, error) -> None:
+        try:
             self._loop.call_soon_threadsafe(job.pool.deliver, job, outputs, error)
+        except RuntimeError:
+            pass  # event loop already closed; the futures died with it
+
+    def _drain_remaining(self) -> None:
+        """Fail queued-but-never-run jobs fast instead of leaving their
+        clients to hit the full RPC timeout."""
+        while True:
+            try:
+                _, _, job = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if job is not None:
+                self._deliver(job, None, RuntimeError("runtime shut down"))
 
     def shutdown(self, timeout: float = 5.0) -> None:
         self._stop.set()

@@ -140,7 +140,18 @@ class TaskPool:
                     break
                 batch.append(task)
                 rows += task.n_rows
-            self._dispatch(batch, rows, runtime)
+            try:
+                self._dispatch(batch, rows, runtime)
+            except Exception as e:
+                # a malformed task (wrong arity/shape/dtype) must fail ITS
+                # batch, not kill the manager — that would silently hang
+                # every future request to this expert
+                logger.exception("failed to form batch in pool %s", self.name)
+                for t in batch:
+                    if not t.future.done():
+                        t.future.set_exception(
+                            ValueError(f"batch formation failed: {e}")
+                        )
 
     def _dispatch(self, batch: list[_Task], rows: int, runtime) -> None:
         target = bucket_rows(rows, self.max_batch_size) if self.pad_buckets else rows

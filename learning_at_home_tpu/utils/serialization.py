@@ -86,6 +86,11 @@ def unpack_message(payload: bytes) -> tuple[str, list[np.ndarray], dict]:
     offset = 4 + hlen
     for dtype_name, shape, nbytes in header["ts"]:
         dt = np.dtype(dtype_name)
+        if nbytes < 0 or any(d < 0 for d in shape):
+            raise ValueError(
+                f"malformed tensor spec: negative dims in {dtype_name}{shape}"
+                f"/{nbytes}"
+            )
         count = int(np.prod(shape, dtype=np.int64)) if shape else 1
         if nbytes != count * dt.itemsize:
             raise ValueError(

@@ -1,0 +1,207 @@
+"""M2 tests: Kademlia DHT — unit tier (routing table) + localhost swarm
+integration (store/get across nodes, expiry, expert declare/discover),
+mirroring the reference's test_dht.py strategy (SURVEY.md §4)."""
+
+import asyncio
+import time
+
+import pytest
+
+from learning_at_home_tpu.dht import DHT, DHTNode, uid_prefixes
+from learning_at_home_tpu.dht.protocol import DHTRecordStorage, PLAIN_SUBKEY
+from learning_at_home_tpu.dht.routing import DHTID, KBucket, RoutingTable
+from learning_at_home_tpu.utils.timed_storage import get_dht_time
+
+
+# ---------------- unit tier ----------------
+
+
+def test_dhtid():
+    a, b = DHTID.generate(), DHTID.generate()
+    assert a != b
+    assert a.xor_distance(a) == 0
+    assert a.xor_distance(b) == b.xor_distance(a)
+    assert DHTID.from_bytes(a.to_bytes()) == a
+    assert DHTID.from_key("expert.1") == DHTID.from_key("expert.1")
+    assert DHTID.from_key("expert.1") != DHTID.from_key("expert.2")
+
+
+def test_kbucket_lru_and_replacement():
+    bucket = KBucket(0, 2**160, k=3)
+    ids = [DHTID(i + 1) for i in range(5)]
+    for i, nid in enumerate(ids[:3]):
+        assert bucket.add_or_update(nid, ("h", i))
+    assert not bucket.add_or_update(ids[3], ("h", 3))  # full → replacement
+    assert ids[3] in bucket.replacement
+    # refresh moves to LRU tail
+    bucket.add_or_update(ids[0], ("h", 0))
+    assert bucket.oldest[0] == ids[1]
+    # removal promotes from replacement
+    bucket.remove(ids[1])
+    assert ids[3] in bucket.peers and ids[1] not in bucket.peers
+
+
+def test_routing_table_split_and_nearest():
+    own = DHTID(2**159)  # sits in the upper half
+    table = RoutingTable(own, bucket_size=4)
+    for i in range(64):
+        table.add_or_update_node(DHTID.from_key(f"n{i}"), ("h", i))
+    assert len(table.buckets) > 1
+    assert len(table) > 4
+    target = DHTID.from_key("target")
+    nearest = table.nearest_neighbors(target, 5)
+    assert len(nearest) == 5
+    dists = [int(nid) ^ int(target) for nid, _ in nearest]
+    assert dists == sorted(dists)
+    # exhaustive check: these really are the closest known
+    all_dists = sorted(
+        int(nid) ^ int(target) for b in table.buckets for nid in b.peers
+    )
+    assert dists == all_dists[:5]
+
+
+def test_record_storage_subkeys(monkeypatch):
+    now = [100.0]
+    monkeypatch.setattr(
+        "learning_at_home_tpu.utils.timed_storage.get_dht_time", lambda: now[0]
+    )
+    st = DHTRecordStorage()
+    assert st.store(b"k", "a", 1, 110.0)
+    assert st.store(b"k", "b", 2, 120.0)
+    assert not st.store(b"k", "a", 0, 105.0)  # older expiration loses
+    assert st.get(b"k") == {"a": (1, 110.0), "b": (2, 120.0)}
+    now[0] = 115.0
+    assert st.get(b"k") == {"b": (2, 120.0)}  # 'a' expired individually
+
+
+def test_uid_prefixes():
+    assert uid_prefixes("ffn.4.17") == ["ffn", "ffn.4"]
+    assert uid_prefixes("expert.3") == ["expert"]
+
+
+# ---------------- swarm tier (real protocol traffic on localhost) ----------------
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_swarm(n, **kwargs):
+    first = await DHTNode.create(**kwargs)
+    nodes = [first]
+    for _ in range(n - 1):
+        nodes.append(
+            await DHTNode.create(initial_peers=[first.endpoint], **kwargs)
+        )
+    return nodes
+
+
+async def teardown(nodes):
+    await asyncio.gather(*(n.shutdown() for n in nodes))
+
+
+def test_swarm_store_get_across_nodes():
+    async def main():
+        nodes = await make_swarm(8, bucket_size=4)
+        try:
+            ok = await nodes[2].store("the-key", [1, 2, 3], get_dht_time() + 30)
+            assert ok
+            # a DIFFERENT node must find the value via iterative lookup
+            rec = await nodes[7].get("the-key")
+            assert rec[PLAIN_SUBKEY][0] == [1, 2, 3]
+            # a key nobody stored is absent
+            assert await nodes[5].get("missing-key") == {}
+        finally:
+            await teardown(nodes)
+
+    run(main())
+
+
+def test_swarm_expiration_is_failure_detection():
+    async def main():
+        nodes = await make_swarm(4, bucket_size=4)
+        try:
+            await nodes[0].store("ephemeral", "v", get_dht_time() + 0.5)
+            assert (await nodes[3].get("ephemeral"))[PLAIN_SUBKEY][0] == "v"
+            await asyncio.sleep(0.6)
+            assert await nodes[3].get("ephemeral") == {}  # gone ⇒ 'dead'
+        finally:
+            await teardown(nodes)
+
+    run(main())
+
+
+def test_swarm_subkey_merge_from_different_writers():
+    """Two servers declare under one prefix key; readers see both."""
+
+    async def main():
+        nodes = await make_swarm(5, bucket_size=4)
+        try:
+            exp = get_dht_time() + 30
+            await nodes[1].store("ffn", ["hostA", 1], exp, subkey="ffn.0")
+            await nodes[2].store("ffn", ["hostB", 2], exp, subkey="ffn.1")
+            rec = await nodes[4].get("ffn")
+            assert rec["ffn.0"][0] == ["hostA", 1]
+            assert rec["ffn.1"][0] == ["hostB", 2]
+        finally:
+            await teardown(nodes)
+
+    run(main())
+
+
+def test_node_failure_lookup_still_works():
+    async def main():
+        nodes = await make_swarm(6, bucket_size=4)
+        try:
+            await nodes[0].store("durable", 42, get_dht_time() + 30)
+            # kill two nodes; replication across k closest should survive
+            await nodes[1].shutdown()
+            await nodes[2].shutdown()
+            rec = await nodes[5].get("durable")
+            assert rec and rec[PLAIN_SUBKEY][0] == 42
+        finally:
+            await teardown([nodes[0], *nodes[3:]])
+
+    run(main())
+
+
+# ---------------- DHT facade (thread-bridged) ----------------
+
+
+def test_dht_facade_declare_and_discover():
+    dht1 = DHT()
+    dht2 = DHT(initial_peers=[dht1.endpoint])
+    try:
+        n = dht1.declare_experts_sync(
+            ["ffn.0.0", "ffn.0.1", "ffn.1.1"], ("10.0.0.1", 9000), expiration=30
+        )
+        assert n == 3
+        # full-uid resolution from the OTHER node
+        eps = dht2.get_experts_sync(["ffn.0.1", "ffn.9.9"])
+        assert eps["ffn.0.1"] == ("10.0.0.1", 9000)
+        assert eps["ffn.9.9"] is None
+        # enumeration via top-level prefix record
+        alive = dht2._loop.run(dht2._get_alive("ffn"))
+        assert set(alive) == {"ffn.0.0", "ffn.0.1", "ffn.1.1"}
+        # beam-search primitive: which sub-prefixes are active
+        active = dht2._loop.run(dht2._first_k_active(["ffn.0", "ffn.7", "ffn.1"], 2))
+        assert active["ffn.0"] is True
+        assert active["ffn.7"] is False
+        assert active["ffn.1"] is True
+    finally:
+        dht2.shutdown()
+        dht1.shutdown()
+
+
+def test_dht_facade_bridge_from_foreign_loop():
+    """The async API must work when awaited from a different event loop."""
+    dht = DHT()
+    try:
+        async def foreign():
+            await dht.declare_experts(["e.0"], ("1.2.3.4", 5), expiration=10)
+            return await dht.get_experts(["e.0"])
+
+        result = asyncio.run(foreign())
+        assert result["e.0"] == ("1.2.3.4", 5)
+    finally:
+        dht.shutdown()
