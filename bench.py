@@ -1,0 +1,81 @@
+"""North-star benchmark: DMoE-Transformer training tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extra}.
+Runs the flagship sharded-MoE training step on whatever device is present
+(the driver runs it on the real TPU chip; falls back to CPU for local
+smoke).  ``vs_baseline`` is 1.0 by definition: the reference's published
+numbers are unrecoverable in this environment (BASELINE.md — empty
+``published`` table, unreadable mount), so this benchmark IS the baseline
+the next rounds must beat.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+
+    import dataclasses
+
+    from __graft_entry__ import _flagship
+    from learning_at_home_tpu.models.transformer import DMoETransformerLM
+    from learning_at_home_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    mesh = make_mesh({"expert": 1}, devices=jax.devices()[:1])
+    model, cfg = _flagship(mesh)  # ONE flagship definition, shared with the driver
+    if not on_tpu:  # local smoke only: shrink to something a 1-core CPU can turn
+        cfg = dataclasses.replace(cfg, num_experts=8, dtype=jnp.float32)
+        model = DMoETransformerLM(cfg, mesh)
+    batch = 32 if on_tpu else 4
+    params = model.init_params(jax.random.PRNGKey(0))
+    optimizer = optax.adamw(1e-3)
+    opt_state = jax.jit(optimizer.init)(params)
+    step = model.make_train_step(optimizer)
+
+    rs = np.random.RandomState(0)
+    sharding = batch_sharding(mesh)
+    ids = jax.device_put(
+        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))), sharding
+    )
+    tgt = jax.device_put(
+        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))), sharding
+    )
+
+    # warmup / compile
+    params, opt_state, loss, _ = step(params, opt_state, ids, tgt)
+    jax.block_until_ready(loss)
+
+    n_steps = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss, metrics = step(params, opt_state, ids, tgt)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * cfg.seq_len
+    tps = tokens_per_step * n_steps / elapsed
+    result = {
+        "metric": "DMoE-Transformer training throughput "
+        f"({cfg.num_experts} experts, d_model={cfg.d_model}, "
+        f"L={cfg.n_layers}, seq={cfg.seq_len}, batch={batch}, top-{cfg.k})",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "platform": platform,
+        "step_ms": round(1000 * elapsed / n_steps, 2),
+        "final_loss": round(float(loss), 4),
+        "dropped_fraction": round(float(metrics["dropped_fraction"]), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

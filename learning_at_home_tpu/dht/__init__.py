@@ -62,10 +62,16 @@ class DHT:
         **node_kwargs,
     ):
         self._loop = BackgroundLoop(name="lah-dht")
-        self.node: DHTNode = self._loop.run(
-            DHTNode.create(host=host, port=port, initial_peers=initial_peers, **node_kwargs),
-            timeout=30,
-        )
+        try:
+            self.node: DHTNode = self._loop.run(
+                DHTNode.create(
+                    host=host, port=port, initial_peers=initial_peers, **node_kwargs
+                ),
+                timeout=30,
+            )
+        except BaseException:
+            self._loop.shutdown()  # don't leak the loop thread on failed init
+            raise
 
     @property
     def endpoint(self) -> Endpoint:
@@ -100,32 +106,49 @@ class DHT:
         return await self._bridge(self._declare(uids, endpoint, expiration))
 
     async def _declare(self, uids, endpoint, expiration) -> int:
-        """Returns how many of ``uids`` had their full record stored."""
+        """Returns how many of ``uids`` had their full record stored.
+
+        Prefix records are grouped by key: one iterative lookup + one
+        batched store per distinct prefix, not one per (uid, prefix) — for
+        a 256-expert server the heartbeat is a handful of lookups, not
+        hundreds."""
         expires_at = get_dht_time() + expiration
         value = [endpoint[0], int(endpoint[1])]
-        full = await asyncio.gather(
-            *(self.node.store(uid, value, expires_at) for uid in uids)
-        )
-        await asyncio.gather(
+        by_prefix: dict[str, list] = {}
+        for uid in uids:
+            for prefix in uid_prefixes(uid):
+                by_prefix.setdefault(prefix, []).append((uid, value, expires_at))
+        results = await asyncio.gather(
+            *(self.node.store(uid, value, expires_at) for uid in uids),
             *(
-                self.node.store(prefix, value, expires_at, subkey=uid)
-                for uid in uids
-                for prefix in uid_prefixes(uid)
-            )
+                self.node.store_batch(prefix, entries)
+                for prefix, entries in by_prefix.items()
+            ),
         )
-        return sum(bool(r) for r in full)
+        return sum(bool(r) for r in results[: len(uids)])
 
     async def get_experts(
         self, uids: Sequence[str]
     ) -> dict[str, Optional[Endpoint]]:
         return await self._bridge(self._get_experts(uids))
 
+    @staticmethod
+    def _parse_endpoint(value) -> Optional[Endpoint]:
+        """Peer-supplied record value → (host, port), or None if malformed."""
+        try:
+            host, port = value[0], int(value[1])
+            if not isinstance(host, str):
+                return None
+            return (host, port)
+        except (TypeError, ValueError, IndexError, KeyError):
+            return None
+
     async def _get_experts(self, uids) -> dict[str, Optional[Endpoint]]:
         records = await asyncio.gather(*(self.node.get(uid) for uid in uids))
         out: dict[str, Optional[Endpoint]] = {}
         for uid, rec in zip(uids, records):
             entry = rec.get(PLAIN_SUBKEY)
-            out[uid] = (entry[0][0], int(entry[0][1])) if entry else None
+            out[uid] = self._parse_endpoint(entry[0]) if entry else None
         return out
 
     # ---- ExpertSource protocol (used by RemoteMixtureOfExperts) ----
@@ -135,11 +158,14 @@ class DHT:
 
     async def _get_alive(self, prefix: str) -> dict[str, Endpoint]:
         records = await self.node.get(prefix)
-        return {
-            uid: (v[0], int(v[1]))
-            for uid, (v, _) in records.items()
-            if uid != PLAIN_SUBKEY
-        }
+        out = {}
+        for uid, (v, _) in records.items():
+            if uid == PLAIN_SUBKEY:
+                continue
+            endpoint = self._parse_endpoint(v)
+            if endpoint is not None:  # skip malformed peer-supplied values
+                out[uid] = endpoint
+        return out
 
     async def first_k_active(
         self, prefixes: Sequence[str], k: int
@@ -152,15 +178,10 @@ class DHT:
 
     async def _first_k_active(self, prefixes, k) -> dict[str, bool]:
         records = await asyncio.gather(*(self.node.get(p) for p in prefixes))
-        out = {}
-        active = 0
-        for p, rec in zip(prefixes, records):
-            alive = any(sk != PLAIN_SUBKEY for sk in rec)
-            out[p] = alive
-            active += alive
-            if active >= k:
-                break
-        return out
+        return {
+            p: any(sk != PLAIN_SUBKEY for sk in rec)
+            for p, rec in zip(prefixes, records)
+        }
 
     # ---- sync conveniences for scripts/tests ----
 

@@ -147,21 +147,32 @@ class DHTNode:
         subkey: str = PLAIN_SUBKEY,
     ) -> bool:
         """Write (subkey → value, expiration) onto the k closest nodes."""
+        result = await self.store_batch(key, [(subkey, value, expiration)])
+        return result[subkey]
+
+    async def store_batch(
+        self, key: str | bytes, entries: Sequence[tuple[str, Any, DHTExpiration]]
+    ) -> dict[str, bool]:
+        """Write many subkeys of ONE key with a single iterative lookup and
+        one batched store RPC per neighbor (the heartbeat hot path: all
+        experts under a shared prefix key go out in one call)."""
         target = DHTID.from_key(key)
         nearest = await self.find_nearest_nodes(target)
-        item = (target.to_bytes(), subkey, value, expiration)
+        items = [(target.to_bytes(), sk, v, e) for sk, v, e in entries]
         results = await asyncio.gather(
-            *(self.protocol.call_store(ep, [item]) for _, ep in nearest)
+            *(self.protocol.call_store(ep, items) for _, ep in nearest)
         )
-        stored_remote = sum(r is not None and r.get(subkey, False) for r in results)
+        ok = {sk: any(r is not None and r.get(sk, False) for r in results)
+              for sk, _, _ in entries}
         # replicate locally when we are within the k closest (or swarm is tiny)
         if len(nearest) < self.bucket_size or any(
             int(self.node_id) ^ int(target) < int(nid) ^ int(target)
             for nid, _ in nearest
         ):
-            self.storage.store(target.to_bytes(), subkey, value, expiration)
-            stored_remote += 1
-        return stored_remote > 0
+            for sk, v, e in entries:
+                if self.storage.store(target.to_bytes(), sk, v, e):
+                    ok[sk] = True
+        return ok
 
     async def get(
         self, key: str | bytes

@@ -24,7 +24,7 @@ import logging
 from typing import Any, Optional
 
 from learning_at_home_tpu.dht.routing import DHTID, Endpoint, RoutingTable
-from learning_at_home_tpu.utils.connection import ConnectionPool
+from learning_at_home_tpu.utils.connection import PoolRegistry
 from learning_at_home_tpu.utils.serialization import (
     pack_message,
     recv_frame,
@@ -84,7 +84,7 @@ class DHTProtocol:
         self.storage = storage
         self.rpc_timeout = rpc_timeout
         self.listen_port: Optional[int] = None  # set by DHTNode after bind
-        self._pools: dict[Endpoint, ConnectionPool] = {}
+        self._pools = PoolRegistry(max_connections_per_endpoint=2)
         self._server: Optional[asyncio.base_events.Server] = None
         self._handler_tasks: set[asyncio.Task] = set()
 
@@ -102,8 +102,7 @@ class DHTProtocol:
         # py3.12's wait_closed() would block forever — cancel them instead
         for task in list(self._handler_tasks):
             task.cancel()
-        for pool in self._pools.values():
-            pool.close()
+        self._pools.close()
 
     async def _handle(self, reader, writer) -> None:
         task = asyncio.current_task()
@@ -160,16 +159,10 @@ class DHTProtocol:
 
     # ---------------- client side ----------------
 
-    def _pool(self, endpoint: Endpoint) -> ConnectionPool:
-        endpoint = (endpoint[0], int(endpoint[1]))
-        if endpoint not in self._pools:
-            self._pools[endpoint] = ConnectionPool(endpoint, max_connections=2)
-        return self._pools[endpoint]
-
     async def _call(self, endpoint: Endpoint, msg_type: str, meta: dict) -> Optional[dict]:
         meta = {**meta, "from": self.node_id.to_bytes(), "port": self.listen_port}
         try:
-            _, reply = await self._pool(endpoint).rpc(
+            _, reply = await self._pools.get(endpoint).rpc(
                 msg_type, (), meta, timeout=self.rpc_timeout
             )
             return reply

@@ -74,8 +74,9 @@ class KBucket:
         return False
 
     def remove(self, node_id: DHTID) -> None:
-        self.peers.pop(node_id, None)
-        if self.replacement:
+        was_main = self.peers.pop(node_id, None) is not None
+        self.replacement.pop(node_id, None)  # a dead node must not be promoted
+        if was_main and self.replacement:
             rid = next(iter(self.replacement))
             self.peers[rid] = self.replacement.pop(rid)
 

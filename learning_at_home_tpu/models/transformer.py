@@ -1,0 +1,198 @@
+"""DMoE-Transformer language model — the flagship ([BJ] config 3/5).
+
+The reference's headline experiment: a Transformer LM whose FFN layers are
+mixtures of experts (256-expert grid on WikiText-103 — SURVEY.md §3.5).
+Two deployment modes share this module:
+
+- **pod mode** (this file's train step): MoE FFNs are
+  ``ShardedMixtureOfExperts`` — experts sharded over the mesh's ``expert``
+  axis, dispatch via ``lax.all_to_all`` inside one compiled program.
+- **swarm mode**: the same trunk with ``RemoteMixtureOfExperts`` FFNs
+  calling DHT-discovered servers (see ``experiments/``).
+
+Design notes for the MXU: everything is einsum-shaped, params in float32
+with bfloat16 compute, static shapes throughout, optional per-layer remat
+(``jax.checkpoint``) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learning_at_home_tpu.parallel.mesh import batch_sharding
+from learning_at_home_tpu.parallel.sharded_moe import ShardedMixtureOfExperts
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DMoETransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    seq_len: int = 256
+    num_experts: int = 256
+    k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    tie_embeddings: bool = True
+
+
+class DMoETransformerLM:
+    """Functional model: explicit param pytree, jit/pjit-friendly apply."""
+
+    def __init__(self, config: DMoETransformerConfig, mesh: Mesh):
+        self.cfg = config
+        self.mesh = mesh
+        self.moe = ShardedMixtureOfExperts(
+            mesh,
+            hidden_dim=config.d_model,
+            num_experts=config.num_experts,
+            k=config.k,
+            capacity_factor=config.capacity_factor,
+            dtype=config.dtype,
+            param_dtype=config.param_dtype,
+        )
+
+    # ---- parameters ----
+
+    def init_params(self, rng: jax.Array) -> Params:
+        cfg = self.cfg
+        d, v, s = cfg.d_model, cfg.vocab_size, cfg.seq_len
+        dense = jax.nn.initializers.lecun_normal()
+        embed_init = jax.nn.initializers.normal(1.0 / np.sqrt(d))
+        keys = iter(jax.random.split(rng, 4 + 6 * cfg.n_layers))
+        pdt = cfg.param_dtype
+
+        def ln():
+            return {"scale": jnp.ones((d,), pdt), "bias": jnp.zeros((d,), pdt)}
+
+        params: dict = {
+            "embed": embed_init(next(keys), (v, d), pdt),
+            "pos": embed_init(next(keys), (s, d), pdt),
+            "ln_f": ln(),
+            "layers": [],
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = dense(next(keys), (d, v), pdt)
+        for _ in range(cfg.n_layers):
+            params["layers"].append(
+                {
+                    "ln1": ln(),
+                    "wq": dense(next(keys), (d, d), pdt),
+                    "wk": dense(next(keys), (d, d), pdt),
+                    "wv": dense(next(keys), (d, d), pdt),
+                    "wo": dense(next(keys), (d, d), pdt),
+                    "ln2": ln(),
+                    "moe": self.moe.init_params(next(keys)),
+                }
+            )
+        return jax.device_put(params, self.param_shardings(params))
+
+    def param_shardings(self, params_shape: Params) -> Params:
+        """Replicated everywhere except the expert stacks."""
+        moe_shardings = self.moe.param_shardings()
+        repl = NamedSharding(self.mesh, P())
+
+        def assign(path, leaf):
+            for p in path:
+                name = getattr(p, "key", getattr(p, "name", None))
+                if name == "moe":
+                    inner = path[-1]
+                    return moe_shardings[getattr(inner, "key", None)]
+            return repl
+
+        return jax.tree_util.tree_map_with_path(assign, params_shape)
+
+    # ---- forward ----
+
+    def _ln(self, p, x):
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+        return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+    def _attention(self, lp, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        h = cfg.n_heads
+        hd = d // h
+        q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s, h, hd)
+        k = (x @ lp["wk"].astype(x.dtype)).reshape(b, s, h, hd)
+        v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, h, hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+        return out @ lp["wo"].astype(x.dtype)
+
+    def _layer(self, lp, x):
+        x = x + self._attention(lp, self._ln(lp["ln1"], x))
+        b, s, d = x.shape
+        moe_in = self._ln(lp["ln2"], x).reshape(b * s, d)
+        moe_out, aux = self.moe(lp["moe"], moe_in)
+        x = x + moe_out.reshape(b, s, d)
+        return x, aux
+
+    def apply(self, params: Params, token_ids: jax.Array) -> tuple[jax.Array, dict]:
+        """token_ids [B, S] → logits [B, S, V]; aux dict of scalars."""
+        cfg = self.cfg
+        x = params["embed"][token_ids].astype(cfg.dtype)
+        x = x + params["pos"][None, : token_ids.shape[1]].astype(cfg.dtype)
+        layer_fn = self._layer
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+        aux_total = {"aux_loss": 0.0, "dropped_fraction": 0.0}
+        for lp in params["layers"]:
+            x, aux = layer_fn(lp, x)
+            aux_total = {k: aux_total[k] + aux[k] for k in aux_total}
+        x = self._ln(params["ln_f"], x)
+        head = (
+            params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        ).astype(jnp.float32)
+        logits = x.astype(jnp.float32) @ head
+        aux_mean = {k: v / cfg.n_layers for k, v in aux_total.items()}
+        return logits, aux_mean
+
+    # ---- loss / train step ----
+
+    def loss_fn(
+        self, params: Params, token_ids: jax.Array, targets: jax.Array
+    ) -> tuple[jax.Array, dict]:
+        logits, aux = self.apply(params, token_ids)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+        loss = ce + self.cfg.aux_loss_weight * aux["aux_loss"]
+        return loss, {"ce": ce, **aux}
+
+    def make_train_step(
+        self, optimizer: optax.GradientTransformation
+    ) -> Callable:
+        """Donating, fully-jitted train step; inputs sharded over the mesh."""
+
+        def train_step(params, opt_state, token_ids, targets):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True
+            )(params, token_ids, targets)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, metrics
+
+        data_shard = batch_sharding(self.mesh)
+        return jax.jit(
+            train_step,
+            in_shardings=(None, None, data_shard, data_shard),
+            donate_argnums=(0, 1),
+        )

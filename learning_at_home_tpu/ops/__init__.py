@@ -1,0 +1,15 @@
+from learning_at_home_tpu.ops.moe_dispatch import (
+    DispatchPlan,
+    combine_outputs,
+    compute_capacity,
+    dispatch_tokens,
+    top_k_gating,
+)
+
+__all__ = [
+    "DispatchPlan",
+    "combine_outputs",
+    "compute_capacity",
+    "dispatch_tokens",
+    "top_k_gating",
+]

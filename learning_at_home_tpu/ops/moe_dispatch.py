@@ -1,0 +1,94 @@
+"""Token→expert dispatch math: top-k gating with capacity buckets.
+
+This is the SPMD replacement for the reference's per-request routing
+(``hivemind/client/moe.py`` beam search + k-of-n gather — SURVEY.md §2):
+inside one XLA program, fault tolerance becomes *capacity dropping* —
+tokens beyond an expert's capacity slot are dropped (their combine weight
+is zero), which is the collective-friendly analogue of the reference
+dropping straggler experts (SURVEY.md §7 "k-of-n inside a collective").
+
+All shapes are static (XLA requirement): for ``n`` tokens, ``E`` experts,
+capacity ``C``, the dispatch/combine tensors are ``[n, E, C]``.  The
+one-hot formulation matmuls cleanly onto the MXU; a Pallas kernel can
+replace it later if profiling shows it dominating (SURVEY.md §7 M5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DispatchPlan(NamedTuple):
+    """Static-shape routing decision for one token shard."""
+
+    combine: jax.Array  # [n, E, C] float — gate weight at the token's slot
+    dispatch: jax.Array  # [n, E, C] bool — membership mask
+    aux_loss: jax.Array  # [] load-balance auxiliary (Shazeer-style)
+    dropped_fraction: jax.Array  # [] fraction of (token, choice) pairs dropped
+
+
+def compute_capacity(
+    n_tokens: int, n_experts: int, k: int, capacity_factor: float = 1.25
+) -> int:
+    """Slots per expert so that on-balance routing fits with headroom."""
+    return max(1, math.ceil(n_tokens * k * capacity_factor / n_experts))
+
+
+def top_k_gating(
+    logits: jax.Array, k: int, capacity: int, renormalize: bool = True
+) -> DispatchPlan:
+    """Route each token to its top-k experts, bucketed to static capacity.
+
+    logits: [n, E] raw gate scores.  Tokens claim expert slots in token
+    order (deterministic); a token whose chosen expert is already full has
+    that choice dropped — its combine weight mass is lost, matching the
+    reference's drop-straggler semantics rather than re-routing.
+    """
+    n, num_experts = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)  # [n, E]
+    top_w, top_i = jax.lax.top_k(gates, k)  # [n, k]
+    if renormalize:
+        top_w = top_w / jnp.maximum(
+            top_w.sum(axis=-1, keepdims=True), jnp.finfo(top_w.dtype).tiny
+        )
+
+    combine = jnp.zeros((n, num_experts, capacity), gates.dtype)
+    dispatch = jnp.zeros((n, num_experts, capacity), bool)
+    counts = jnp.zeros((num_experts,), jnp.int32)  # slots used so far
+    kept = jnp.zeros((), jnp.float32)
+
+    for j in range(k):  # k is small and static — unrolled at trace time
+        onehot = jax.nn.one_hot(top_i[:, j], num_experts, dtype=jnp.int32)  # [n, E]
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]  # [n, E]
+        pos = jnp.sum(pos_in_expert * onehot, axis=1)  # [n]
+        fits = pos < capacity
+        slot_onehot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [n, C]
+        mask = (onehot.astype(gates.dtype))[:, :, None] * slot_onehot[:, None, :]
+        mask = mask * fits[:, None, None].astype(gates.dtype)
+        combine = combine + top_w[:, j][:, None, None] * mask
+        dispatch = dispatch | (mask > 0)
+        counts = counts + jnp.sum(onehot, axis=0, dtype=jnp.int32)
+        kept = kept + jnp.sum(fits.astype(jnp.float32))
+
+    # Shazeer/GShard load-balance auxiliary: E * <importance> . <load>
+    importance = gates.mean(axis=0)  # [E]
+    load = (
+        jax.nn.one_hot(top_i[:, 0], num_experts, dtype=gates.dtype).mean(axis=0)
+    )
+    aux_loss = num_experts * jnp.sum(importance * load)
+    dropped = 1.0 - kept / (n * k)
+    return DispatchPlan(combine, dispatch, aux_loss, dropped)
+
+
+def dispatch_tokens(x: jax.Array, plan: DispatchPlan) -> jax.Array:
+    """Scatter tokens into per-expert capacity buckets: [n,d] → [E,C,d]."""
+    return jnp.einsum("nec,nd->ecd", plan.dispatch.astype(x.dtype), x)
+
+
+def combine_outputs(y: jax.Array, plan: DispatchPlan) -> jax.Array:
+    """Gather expert outputs back per token, gate-weighted: [E,C,d] → [n,d]."""
+    return jnp.einsum("nec,ecd->nd", plan.combine.astype(y.dtype), y)

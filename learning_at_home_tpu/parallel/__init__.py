@@ -1,0 +1,17 @@
+from learning_at_home_tpu.parallel.mesh import (
+    batch_sharding,
+    data_axes,
+    expert_sharding,
+    make_mesh,
+    replicated,
+)
+from learning_at_home_tpu.parallel.sharded_moe import ShardedMixtureOfExperts
+
+__all__ = [
+    "batch_sharding",
+    "data_axes",
+    "expert_sharding",
+    "make_mesh",
+    "replicated",
+    "ShardedMixtureOfExperts",
+]

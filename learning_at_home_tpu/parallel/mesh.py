@@ -1,0 +1,57 @@
+"""Device-mesh helpers for the ICI tier.
+
+The TPU-native communication backend (SURVEY.md §2.3): intra-pod expert
+parallelism rides XLA collectives over ICI inside ``shard_map`` programs;
+everything off-slice goes through the DHT + RPC tier.  These helpers build
+the meshes both tiers hang off.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named mesh; axis sizes must multiply to the device count.
+
+    Default: all devices on a single ``expert`` axis (pure expert
+    parallelism — the reference's scaling dimension).  A typical pod-scale
+    layout is ``{"data": 4, "expert": 8}``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"expert": len(devices)}
+    sizes = list(axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {int(np.prod(sizes))} devices, "
+            f"have {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axes))
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes a token batch is sharded over (everything but model axes)."""
+    return tuple(a for a in mesh.axis_names if a in ("data", "expert"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Tokens sharded across all data-bearing axes, features replicated."""
+    return NamedSharding(mesh, P(data_axes(mesh)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def expert_sharding(mesh: Mesh) -> NamedSharding:
+    """Stacked per-expert params: leading axis split over 'expert'."""
+    return NamedSharding(mesh, P("expert"))

@@ -1,0 +1,188 @@
+"""ShardedMixtureOfExperts: the pod-scale expert-parallel MoE FFN.
+
+This is [BJ] config 5 — the intra-pod realization of the reference's DMoE
+(SURVEY.md §2.2 "Expert parallelism", §7 M5): experts live sharded across
+the ``expert`` mesh axis as ONE stacked parameter pytree; a token batch,
+sharded across all devices, is routed by top-k gating, capacity-bucketed,
+and exchanged with **two ``lax.all_to_all`` collectives inside a single
+``shard_map`` program** — not N point-to-point RPCs.  Fault tolerance
+inside the collective is capacity-dropping (SURVEY.md §7 "k-of-n inside a
+collective"); true peer failure handling stays on the DHT/RPC tier.
+
+Data layout through the program (per device; E=global experts, e=local
+experts, ep=expert-axis size, n=local tokens, C=capacity, d=model dim):
+
+    x [n,d] ── gate ──▶ plan [n,E,C] ── dispatch ──▶ [E,C,d]
+      reshape [ep,e,C,d] ── all_to_all ──▶ [ep,e,C,d]   (tokens arrive)
+      regroup [e,ep*C,d] ── batched expert FFN (MXU) ──▶ [e,ep*C,d]
+      regroup [ep,e,C,d] ── all_to_all ──▶ [E,C,d]       (outputs return)
+      combine ──▶ y [n,d]
+
+Expert compute is one batched einsum over the local expert stack — large,
+dense, bfloat16-friendly: exactly what the MXU wants.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from learning_at_home_tpu.ops.moe_dispatch import (
+    combine_outputs,
+    compute_capacity,
+    dispatch_tokens,
+    top_k_gating,
+)
+from learning_at_home_tpu.parallel.mesh import data_axes
+
+Params = dict[str, jax.Array]
+
+
+class ShardedMixtureOfExperts:
+    """Expert-parallel MoE FFN over a mesh with an ``expert`` axis.
+
+    Parameters (``init_params``):
+      gate  [d, E]            — replicated
+      w1    [E, d, ffn]       — sharded on axis 0 over ``expert``
+      b1    [E, ffn]
+      w2    [E, ffn, d]
+      b2    [E, d]
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        hidden_dim: int,
+        num_experts: int,
+        k: int = 2,
+        capacity_factor: float = 1.25,
+        ffn_mult: int = 4,
+        dtype: Any = jnp.bfloat16,
+        param_dtype: Any = jnp.float32,
+    ):
+        if "expert" not in mesh.axis_names:
+            raise ValueError("mesh must have an 'expert' axis")
+        self.mesh = mesh
+        self.ep = mesh.shape["expert"]
+        if num_experts % self.ep:
+            raise ValueError(
+                f"num_experts={num_experts} must divide over expert axis "
+                f"size {self.ep}"
+            )
+        self.hidden_dim = hidden_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.ffn_dim = ffn_mult * hidden_dim
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+        self._shard = data_axes(mesh)  # axes the token batch is split over
+
+    # ---- parameters ----
+
+    def init_params(self, rng: jax.Array) -> Params:
+        kg, k1, k2 = jax.random.split(rng, 3)
+        d, e, f = self.hidden_dim, self.num_experts, self.ffn_dim
+        init = jax.nn.initializers.lecun_normal()
+        params = {
+            "gate": init(kg, (d, e), self.param_dtype),
+            "w1": init(k1, (e, d, f), self.param_dtype),
+            "b1": jnp.zeros((e, f), self.param_dtype),
+            "w2": init(k2, (e, f, d), self.param_dtype),
+            "b2": jnp.zeros((e, d), self.param_dtype),
+        }
+        return jax.device_put(params, self.param_shardings())
+
+    def param_shardings(self) -> dict[str, NamedSharding]:
+        return {
+            "gate": NamedSharding(self.mesh, P()),
+            "w1": NamedSharding(self.mesh, P("expert")),
+            "b1": NamedSharding(self.mesh, P("expert")),
+            "w2": NamedSharding(self.mesh, P("expert")),
+            "b2": NamedSharding(self.mesh, P("expert")),
+        }
+
+    # ---- the sharded program ----
+
+    def __call__(self, params: Params, x: jax.Array) -> tuple[jax.Array, dict]:
+        """x: [n_tokens, d] sharded over the data axes.  Returns (y, aux)."""
+        n_global = x.shape[0]
+        n_shards = 1
+        for a in self._shard:
+            n_shards *= self.mesh.shape[a]
+        if n_global % n_shards:
+            raise ValueError(
+                f"token count {n_global} must divide across {n_shards} shards"
+            )
+        n_local = n_global // n_shards
+        capacity = compute_capacity(
+            n_local, self.num_experts, self.k, self.capacity_factor
+        )
+
+        fn = shard_map(
+            functools.partial(self._local_forward, capacity=capacity),
+            mesh=self.mesh,
+            in_specs=(
+                {
+                    "gate": P(),
+                    "w1": P("expert"),
+                    "b1": P("expert"),
+                    "w2": P("expert"),
+                    "b2": P("expert"),
+                },
+                P(self._shard),
+            ),
+            out_specs=(P(self._shard), {"aux_loss": P(), "dropped_fraction": P()}),
+            check_vma=False,
+        )
+        return fn(params, x)
+
+    def _local_forward(
+        self, params: Params, x: jax.Array, capacity: int
+    ) -> tuple[jax.Array, dict]:
+        e_local = self.num_experts // self.ep
+        d = self.hidden_dim
+        compute = self.dtype
+
+        # 1) gate + routing plan for MY tokens (logits in f32 for stable softmax)
+        logits = (x.astype(compute) @ params["gate"].astype(compute)).astype(
+            jnp.float32
+        )
+        plan = top_k_gating(logits, self.k, capacity)
+
+        # 2) scatter into capacity buckets and exchange over ICI
+        x_send = dispatch_tokens(x.astype(compute), plan)  # [E, C, d]
+        x_send = x_send.reshape(self.ep, e_local, capacity, d)
+        x_recv = jax.lax.all_to_all(
+            x_send, "expert", split_axis=0, concat_axis=0, tiled=False
+        )  # [ep, e_local, C, d] — slice j = tokens from expert-row peer j
+
+        # 3) batched expert FFN on the MXU (one einsum over the local stack)
+        xe = x_recv.transpose(1, 0, 2, 3).reshape(e_local, self.ep * capacity, d)
+        w1 = params["w1"].astype(compute)
+        b1 = params["b1"].astype(compute)
+        w2 = params["w2"].astype(compute)
+        b2 = params["b2"].astype(compute)
+        h = jax.nn.gelu(jnp.einsum("egd,edf->egf", xe, w1) + b1[:, None, :])
+        ye = jnp.einsum("egf,efd->egd", h, w2) + b2[:, None, :]
+
+        # 4) return outputs to their source devices
+        y_send = ye.reshape(e_local, self.ep, capacity, d).transpose(1, 0, 2, 3)
+        y_recv = jax.lax.all_to_all(
+            y_send, "expert", split_axis=0, concat_axis=0, tiled=False
+        ).reshape(self.num_experts, capacity, d)
+
+        # 5) gate-weighted combine for MY tokens
+        y = combine_outputs(y_recv, plan).astype(x.dtype)
+
+        axes = self._shard
+        aux = {
+            "aux_loss": jax.lax.pmean(plan.aux_loss, axes),
+            "dropped_fraction": jax.lax.pmean(plan.dropped_fraction, axes),
+        }
+        return y, aux

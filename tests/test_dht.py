@@ -39,6 +39,11 @@ def test_kbucket_lru_and_replacement():
     # removal promotes from replacement
     bucket.remove(ids[1])
     assert ids[3] in bucket.peers and ids[1] not in bucket.peers
+    # removing a REPLACEMENT node must not promote anything (esp. itself)
+    assert bucket.add_or_update(ids[4], ("h", 4)) is False
+    bucket.remove(ids[4])
+    assert ids[4] not in bucket.peers and ids[4] not in bucket.replacement
+    assert len(bucket.peers) == 3
 
 
 def test_routing_table_split_and_nearest():

@@ -1,0 +1,83 @@
+"""Unit tests for the token-dispatch math (ops/moe_dispatch.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_at_home_tpu.ops import (
+    combine_outputs,
+    compute_capacity,
+    dispatch_tokens,
+    top_k_gating,
+)
+
+
+def test_compute_capacity():
+    assert compute_capacity(128, 8, 2, 1.0) == 32
+    assert compute_capacity(128, 8, 2, 1.25) == 40
+    assert compute_capacity(1, 64, 1, 1.0) == 1  # floor of 1
+
+
+def test_topk_full_capacity_equals_softmax_topk():
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(16, 4).astype(np.float32))
+    plan = top_k_gating(logits, k=2, capacity=16)
+    assert float(plan.dropped_fraction) == 0.0
+
+    gates = np.asarray(jax.nn.softmax(logits, axis=-1))
+    weights = np.asarray(plan.combine.sum(axis=2))  # [n, E]
+    for b in range(16):
+        top2 = np.argsort(-gates[b])[:2]
+        expected = gates[b, top2] / gates[b, top2].sum()
+        np.testing.assert_allclose(
+            np.sort(weights[b][weights[b] > 0]), np.sort(expected), atol=1e-6
+        )
+        assert set(np.nonzero(weights[b])[0]) == set(top2)
+
+
+def test_each_slot_used_once():
+    rs = np.random.RandomState(1)
+    logits = jnp.asarray(rs.randn(64, 8).astype(np.float32))
+    plan = top_k_gating(logits, k=2, capacity=8)
+    # no expert slot is claimed by two tokens
+    slot_usage = np.asarray(plan.dispatch.sum(axis=0))  # [E, C]
+    assert slot_usage.max() <= 1
+
+
+def test_capacity_dropping():
+    # all tokens want expert 0 → only C of them fit
+    logits = jnp.full((10, 4), -10.0).at[:, 0].set(10.0)
+    plan = top_k_gating(logits, k=1, capacity=3)
+    kept = np.asarray(plan.dispatch[:, 0].sum(axis=1))  # per-token kept flag
+    assert kept.sum() == 3
+    # earliest tokens win slots (deterministic token order)
+    np.testing.assert_array_equal(kept[:3], 1)
+    assert float(plan.dropped_fraction) == pytest.approx(0.7)
+
+
+def test_dispatch_combine_roundtrip():
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(32, 8).astype(np.float32))
+    logits = jnp.asarray(rs.randn(32, 4).astype(np.float32))
+    plan = top_k_gating(logits, k=2, capacity=32)
+    buckets = dispatch_tokens(x, plan)  # [E, C, d]
+    assert buckets.shape == (4, 32, 8)
+    # identity expert: combine(dispatch(x)) == x for weight-1 routing
+    plan1 = top_k_gating(logits, k=1, capacity=32)
+    y = combine_outputs(dispatch_tokens(x, plan1), plan1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
+
+
+def test_gating_is_differentiable():
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+    w = jnp.asarray(rs.randn(8, 4).astype(np.float32) * 0.1)
+
+    def loss(w):
+        plan = top_k_gating(x @ w, k=2, capacity=8)
+        return combine_outputs(dispatch_tokens(x, plan), plan).sum() + plan.aux_loss
+
+    g = jax.grad(loss)(w)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0

@@ -1,0 +1,142 @@
+"""Tests for the ICI tier: sharded MoE + DMoE transformer on the virtual
+8-device CPU mesh (SURVEY.md §4 'TPU-build implication')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_at_home_tpu.models.transformer import (
+    DMoETransformerConfig,
+    DMoETransformerLM,
+)
+from learning_at_home_tpu.parallel import (
+    ShardedMixtureOfExperts,
+    batch_sharding,
+    make_mesh,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def dense_mixture(params, x):
+    """Reference computation: full softmax mixture over all experts."""
+    gates = jax.nn.softmax(np.asarray(x) @ params["gate"], axis=-1)
+    h = np.einsum("nd,edf->enf", np.asarray(x), params["w1"]) + params["b1"][:, None]
+    h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+    ye = np.einsum("enf,efd->end", h, params["w2"]) + params["b2"][:, None]
+    return np.einsum("ne,end->nd", gates, ye)
+
+
+def test_sharded_moe_matches_dense_full_routing():
+    mesh = make_mesh({"data": 2, "expert": 4})
+    moe = ShardedMixtureOfExperts(
+        mesh, hidden_dim=16, num_experts=8, k=8, capacity_factor=8.0,
+        dtype=jnp.float32,
+    )
+    params = moe.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    y, aux = jax.jit(moe.__call__)(params, x)
+    expected = dense_mixture(jax.device_get(params), x)
+    np.testing.assert_allclose(np.asarray(y), expected, atol=1e-5)
+    assert float(aux["dropped_fraction"]) == 0.0
+
+
+def test_sharded_moe_1d_expert_mesh():
+    mesh = make_mesh({"expert": 8})
+    moe = ShardedMixtureOfExperts(
+        mesh, hidden_dim=8, num_experts=16, k=16, capacity_factor=16.0,
+        dtype=jnp.float32,
+    )
+    params = moe.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8), jnp.float32)
+    y, _ = jax.jit(moe.__call__)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y), dense_mixture(jax.device_get(params), x), atol=1e-5
+    )
+
+
+def test_sharded_moe_grads_flow_to_experts():
+    mesh = make_mesh({"data": 2, "expert": 4})
+    moe = ShardedMixtureOfExperts(
+        mesh, hidden_dim=16, num_experts=8, k=2, dtype=jnp.float32
+    )
+    params = moe.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 16), jnp.float32)
+
+    def loss(params):
+        y, aux = moe(params, x)
+        return (y**2).mean() + 0.01 * aux["aux_loss"]
+
+    grads = jax.jit(jax.grad(loss))(params)
+    for name in ("gate", "w1", "w2"):
+        assert float(jnp.abs(grads[name]).sum()) > 0, name
+    # expert grads keep the expert sharding (no accidental replication)
+    assert grads["w1"].sharding.spec == params["w1"].sharding.spec
+
+
+def test_capacity_drop_under_imbalance():
+    mesh = make_mesh({"expert": 8})
+    moe = ShardedMixtureOfExperts(
+        mesh, hidden_dim=8, num_experts=8, k=1, capacity_factor=1.0,
+        dtype=jnp.float32,
+    )
+    params = moe.init_params(jax.random.PRNGKey(0))
+    # steer every token to expert 0 via an extreme gate
+    params = dict(params)
+    params["gate"] = jnp.zeros_like(params["gate"]).at[:, 0].set(100.0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 8), jnp.float32)
+    y, aux = jax.jit(moe.__call__)(params, x)
+    assert float(aux["dropped_fraction"]) > 0.5  # most tokens dropped
+    # dropped tokens produce zero output rows, kept ones nonzero
+    row_norms = np.linalg.norm(np.asarray(y), axis=1)
+    assert (row_norms == 0).sum() >= 32
+
+
+def _tiny_model(mesh, remat=False):
+    cfg = DMoETransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, seq_len=16,
+        num_experts=8, k=2, dtype=jnp.float32, remat=remat,
+    )
+    return DMoETransformerLM(cfg, mesh), cfg
+
+
+def test_transformer_trains_and_keeps_shardings():
+    mesh = make_mesh({"data": 2, "expert": 4})
+    model, cfg = _tiny_model(mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    opt = optax.adamw(1e-3)
+    opt_state = jax.jit(opt.init)(params)
+    step = model.make_train_step(opt)
+
+    rs = np.random.RandomState(0)
+    ids = jax.device_put(
+        jnp.asarray(rs.randint(0, 64, (8, 16))), batch_sharding(mesh)
+    )
+    tgt = jax.device_put(
+        jnp.asarray(rs.randint(0, 64, (8, 16))), batch_sharding(mesh)
+    )
+    losses = []
+    for _ in range(6):
+        params, opt_state, loss, metrics = step(params, opt_state, ids, tgt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+    w1 = params["layers"][0]["moe"]["w1"]
+    assert "expert" in str(w1.sharding.spec)
+
+
+def test_transformer_remat_matches():
+    mesh = make_mesh({"data": 2, "expert": 4})
+    model, _ = _tiny_model(mesh, remat=False)
+    model_r, _ = _tiny_model(mesh, remat=True)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(1)
+    ids = jnp.asarray(rs.randint(0, 64, (4, 16)))
+    tgt = jnp.asarray(rs.randint(0, 64, (4, 16)))
+    l1, _ = jax.jit(model.loss_fn)(params, ids, tgt)
+    l2, _ = jax.jit(model_r.loss_fn)(params, ids, tgt)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
