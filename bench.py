@@ -37,7 +37,7 @@ def main() -> None:
     batch = 32 if on_tpu else 4
     params = model.init_params(jax.random.PRNGKey(0))
     optimizer = optax.adamw(1e-3)
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_state = model.init_opt_state(optimizer, params)
     step = model.make_train_step(optimizer)
 
     rs = np.random.RandomState(0)

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""DHT benchmark: store/get ops/sec and latency vs swarm size
+(the reference's DHT measurement harness — SURVEY.md §2/§4).
+
+Example:
+  python experiments/benchmark_dht.py --nodes 16 --ops 200
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+async def bench(n_nodes: int, n_ops: int, bucket_size: int):
+    import numpy as np
+
+    from learning_at_home_tpu.dht.node import DHTNode
+    from learning_at_home_tpu.utils.timed_storage import get_dht_time
+
+    first = await DHTNode.create(bucket_size=bucket_size)
+    nodes = [first]
+    for _ in range(n_nodes - 1):
+        nodes.append(
+            await DHTNode.create(initial_peers=[first.endpoint], bucket_size=bucket_size)
+        )
+
+    rs = np.random.RandomState(0)
+    keys = [f"bench-key-{i}" for i in range(n_ops)]
+
+    store_lat = []
+    t0 = time.monotonic()
+    for i, key in enumerate(keys):
+        node = nodes[rs.randint(n_nodes)]
+        t = time.monotonic()
+        ok = await node.store(key, i, get_dht_time() + 300)
+        store_lat.append(time.monotonic() - t)
+        assert ok
+    store_elapsed = time.monotonic() - t0
+
+    get_lat = []
+    hits = 0
+    t0 = time.monotonic()
+    for i, key in enumerate(keys):
+        node = nodes[rs.randint(n_nodes)]
+        t = time.monotonic()
+        rec = await node.get(key)
+        get_lat.append(time.monotonic() - t)
+        hits += bool(rec) and rec[""][0] == i
+    get_elapsed = time.monotonic() - t0
+
+    await asyncio.gather(*(n.shutdown() for n in nodes))
+    sl = np.asarray(store_lat) * 1000
+    gl = np.asarray(get_lat) * 1000
+    return {
+        "metric": "DHT ops",
+        "nodes": n_nodes,
+        "store_ops_per_sec": round(n_ops / store_elapsed, 1),
+        "get_ops_per_sec": round(n_ops / get_elapsed, 1),
+        "store_latency_ms": {"p50": round(float(np.percentile(sl, 50)), 2),
+                             "p99": round(float(np.percentile(sl, 99)), 2)},
+        "get_latency_ms": {"p50": round(float(np.percentile(gl, 50)), 2),
+                           "p99": round(float(np.percentile(gl, 99)), 2)},
+        "hit_rate": round(hits / n_ops, 4),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=8)
+    p.add_argument("--ops", type=int, default=100)
+    p.add_argument("--bucket-size", type=int, default=8)
+    args = p.parse_args()
+    print(json.dumps(asyncio.run(bench(args.nodes, args.ops, args.bucket_size))))
+
+
+if __name__ == "__main__":
+    main()

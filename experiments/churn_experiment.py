@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Convergence under churn: train against a swarm while servers die and
+come back ([BJ] config 4; the reference's churn/latency simulation —
+SURVEY.md §2 'Experiment scripts', §5.3).
+
+Several expert servers host one grid; on a fixed schedule a server is
+killed (its DHT records expire → routing drops it) and later restarted
+(it re-declares → routing picks it back up).  The trainer keeps stepping
+the whole time with k-of-n quorum; the script reports the loss curve,
+quorum failures, and effective alive-expert counts.
+
+Example:
+  python experiments/churn_experiment.py --steps 60 --kill-every 20
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--kill-every", type=int, default=20, help="steps between kills")
+    p.add_argument("--dead-for", type=int, default=10, help="steps a server stays dead")
+    p.add_argument("--n-servers", type=int, default=3)
+    p.add_argument("--experts-per-server", type=int, default=2)
+    p.add_argument("--hidden-dim", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--ttl", type=float, default=1.0, help="expert record TTL (s)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.moe import MoEDispatchError, RemoteMixtureOfExperts
+    from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.models import make_expert
+    from learning_at_home_tpu.server import ExpertBackend, Server
+
+    n_experts = args.n_servers * args.experts_per_server
+    bootstrap = DHT()
+    dhts = [bootstrap]
+
+    def make_server(server_idx: int) -> Server:
+        experts = {}
+        for i in range(n_experts):
+            if i % args.n_servers != server_idx:
+                continue
+            uid = f"churn.{i}"
+            apply_fn, params = make_expert(
+                "ffn",
+                args.hidden_dim,
+                jax.random.PRNGKey(1000 + i),
+                jnp.zeros((2, args.hidden_dim)),
+            )
+            experts[uid] = ExpertBackend(uid, apply_fn, params, optax.adam(1e-3))
+        dht = DHT(initial_peers=[bootstrap.endpoint])
+        dhts.append(dht)
+        server = Server(
+            experts, host="127.0.0.1", dht=dht, update_period=args.ttl / 2
+        )
+        server.run_in_background()
+        return server
+
+    servers: dict[int, Server] = {i: make_server(i) for i in range(args.n_servers)}
+    client_dht = DHT(initial_peers=[bootstrap.endpoint])
+    dhts.append(client_dht)
+
+    moe = RemoteMixtureOfExperts(
+        in_features=args.hidden_dim,
+        grid_size=(n_experts,),
+        uid_prefix="churn",
+        source=client_dht,
+        k_best=min(4, n_experts),
+        k_min=1,
+        timeout_after_k_min=0.2,
+        # generous: first-time XLA compiles per batch bucket can take
+        # seconds; a short timeout misreads compiling experts as dead
+        forward_timeout=30.0,
+        backward_timeout=30.0,
+        alive_ttl=args.ttl / 2,
+    )
+    gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(gate)
+
+    # toy regression task: y = roll(x); trains gate + experts jointly
+    rs = np.random.RandomState(args.seed)
+    X = rs.randn(256, args.hidden_dim).astype(np.float32)
+    Y = np.roll(X, 1, axis=1)
+
+    # wait for discovery
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if len(client_dht._loop.run(client_dht._get_alive("churn"))) == n_experts:
+            break
+        time.sleep(0.1)
+
+    def loss_fn(gate, x, y):
+        return jnp.mean((moe(x, gate) - y) ** 2)
+
+    dead_since: dict[int, int] = {}
+    quorum_failures = 0
+    victim = 0
+    for step in range(args.steps):
+        # churn schedule
+        if args.kill_every and step > 0 and step % args.kill_every == 0:
+            v = victim % args.n_servers
+            if v not in dead_since and len(dead_since) < args.n_servers - 1:
+                servers[v].dht.shutdown()
+                servers[v].shutdown()
+                dead_since[v] = step
+                print(json.dumps({"event": "kill", "server": v, "step": step}), flush=True)
+            victim += 1
+        for v, since in list(dead_since.items()):
+            if step - since >= args.dead_for:
+                servers[v] = make_server(v)
+                del dead_since[v]
+                print(json.dumps({"event": "restart", "server": v, "step": step}), flush=True)
+
+        idx = rs.randint(0, len(X), args.batch_size)
+        x, y = jnp.asarray(X[idx]), jnp.asarray(Y[idx])
+        try:
+            loss, grads = jax.value_and_grad(loss_fn)(gate, x, y)
+            updates, opt_state = opt.update(grads, opt_state)
+            gate = optax.apply_updates(gate, updates)
+        except Exception as e:  # quorum failure: skip the batch, keep going
+            quorum_failures += 1
+            print(json.dumps({"event": "quorum_failure", "step": step,
+                              "error": str(e)[:80]}), flush=True)
+            time.sleep(0.25)
+            continue
+        if step % 5 == 0 or step == args.steps - 1:
+            alive = len(client_dht._loop.run(client_dht._get_alive("churn")))
+            print(
+                json.dumps(
+                    {
+                        "step": step,
+                        "loss": round(float(loss), 4),
+                        "alive_experts": alive,
+                        "dead_servers": sorted(dead_since),
+                        "quorum_failures": quorum_failures,
+                    }
+                ),
+                flush=True,
+            )
+
+    p50 = float(np.median(list(moe.dispatch_times)) * 1000)
+    print(
+        json.dumps(
+            {
+                "metric": "churn summary",
+                "steps": args.steps,
+                "quorum_failures": quorum_failures,
+                "quorum_success_rate": round(1 - quorum_failures / args.steps, 4),
+                "dispatch_p50_ms": round(p50, 2),
+            }
+        ),
+        flush=True,
+    )
+    for server in servers.values():
+        server.shutdown()
+    for dht in dhts:
+        dht.shutdown()
+    reset_client_rpc()
+
+
+if __name__ == "__main__":
+    main()

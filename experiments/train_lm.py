@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""DMoE-Transformer LM training — [BJ] config 3 (256-expert grid).
+
+Two modes, one CLI:
+
+- ``--mode pod``   : the TPU-native path — experts sharded over the device
+  mesh, all_to_all dispatch, single jitted train step.
+- ``--mode swarm`` : the reference's decentralized path — this process
+  starts N expert servers + a DHT swarm on localhost, then trains a local
+  trunk against DHT-discovered remote experts (async server-side SGD).
+
+Data: ``--data /path/to/wikitext.txt`` (or .npy token file) reproduces the
+reference setup; without it a synthetic Zipfian corpus is used (this
+sandbox has no network egress — see models/data.py).
+
+Examples:
+  python experiments/train_lm.py --mode pod --steps 200
+  python experiments/train_lm.py --mode swarm --experts-per-layer 16 \
+      --n-servers 2 --steps 50
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=["pod", "swarm"], default="pod")
+    p.add_argument("--data", default=None, help="local corpus (.txt/.npy)")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--num-experts", type=int, default=256, help="pod mode")
+    p.add_argument("--experts-per-layer", type=int, default=16, help="swarm mode")
+    p.add_argument("--n-servers", type=int, default=2, help="swarm mode")
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def run_pod(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.models.data import VOCAB_SIZE, LMBatcher, load_corpus
+    from learning_at_home_tpu.models.transformer import (
+        DMoETransformerConfig,
+        DMoETransformerLM,
+    )
+    from learning_at_home_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    n_dev = len(jax.devices())
+    dp = 2 if n_dev % 2 == 0 and n_dev > 2 else 1
+    mesh = make_mesh({"data": dp, "expert": n_dev // dp})
+    cfg = DMoETransformerConfig(
+        vocab_size=VOCAB_SIZE,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        seq_len=args.seq_len,
+        num_experts=args.num_experts,
+        k=args.k,
+        dtype=jnp.bfloat16 if jax.devices()[0].platform != "cpu" else jnp.float32,
+    )
+    model = DMoETransformerLM(cfg, mesh)
+    params = model.init_params(jax.random.PRNGKey(args.seed))
+    optimizer = optax.adamw(args.lr)
+    opt_state = model.init_opt_state(optimizer, params)
+    step_fn = model.make_train_step(optimizer)
+
+    tokens = load_corpus(args.data, seed=args.seed)
+    batches = LMBatcher(tokens, args.batch_size, args.seq_len, seed=args.seed)
+    sharding = batch_sharding(mesh)
+
+    t0 = time.perf_counter()
+    for step, (ids, tgt) in zip(range(args.steps), batches):
+        ids = jax.device_put(jnp.asarray(ids), sharding)
+        tgt = jax.device_put(jnp.asarray(tgt), sharding)
+        params, opt_state, loss, metrics = step_fn(params, opt_state, ids, tgt)
+        if step % args.log_every == 0 or step == args.steps - 1:
+            elapsed = time.perf_counter() - t0
+            tps = (step + 1) * args.batch_size * args.seq_len / elapsed
+            print(
+                json.dumps(
+                    {
+                        "step": step,
+                        "loss": round(float(loss), 4),
+                        "ce": round(float(metrics["ce"]), 4),
+                        "dropped": round(float(metrics["dropped_fraction"]), 4),
+                        "tokens_per_sec": round(tps, 1),
+                    }
+                ),
+                flush=True,
+            )
+
+
+def run_swarm(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.models import make_expert
+    from learning_at_home_tpu.models.data import VOCAB_SIZE, LMBatcher, load_corpus
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmDMoETransformerLM,
+        SwarmTransformerConfig,
+    )
+    from learning_at_home_tpu.server import ExpertBackend, Server
+
+    # grid: experts_per_layer experts in one dimension per layer
+    grid = (args.experts_per_layer,)
+    bootstrap = DHT()
+    servers, dhts = [], [bootstrap]
+    rng = np.random.RandomState(args.seed)
+    for s in range(args.n_servers):
+        experts = {}
+        for layer in range(args.n_layers):
+            for i in range(args.experts_per_layer):
+                if i % args.n_servers != s:
+                    continue  # experts partitioned across servers
+                uid = f"ffn{layer}.{i}"
+                apply_fn, params = make_expert(
+                    "ffn",
+                    args.d_model,
+                    jax.random.PRNGKey(hash((layer, i)) % (1 << 31)),
+                    jnp.zeros((2, args.d_model)),
+                )
+                experts[uid] = ExpertBackend(
+                    uid, apply_fn, params, optax.adam(args.lr), max_batch_size=4096
+                )
+        dht = DHT(initial_peers=[bootstrap.endpoint])
+        dhts.append(dht)
+        server = Server(experts, host="127.0.0.1", dht=dht, update_period=5.0)
+        server.run_in_background()
+        servers.append(server)
+    client_dht = DHT(initial_peers=[bootstrap.endpoint])
+    dhts.append(client_dht)
+
+    # wait for all experts to appear in the DHT
+    want = args.n_layers * args.experts_per_layer
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        found = sum(
+            len(client_dht._loop.run(client_dht._get_alive(f"ffn{l}")))
+            for l in range(args.n_layers)
+        )
+        if found >= want:
+            break
+        time.sleep(0.25)
+    print(f"# discovered {found}/{want} experts via DHT", flush=True)
+
+    cfg = SwarmTransformerConfig(
+        vocab_size=VOCAB_SIZE,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        seq_len=args.seq_len,
+        grid_size=grid,
+        k_best=args.k,
+    )
+    model = SwarmDMoETransformerLM(cfg, client_dht)
+    params = model.init_params(jax.random.PRNGKey(args.seed))
+    optimizer = optax.adamw(args.lr)
+    opt_state = optimizer.init(params)
+    step_fn = model.make_train_step(optimizer)
+
+    tokens = load_corpus(args.data, seed=args.seed)
+    batches = LMBatcher(tokens, args.batch_size, args.seq_len, seed=args.seed)
+
+    try:
+        t0 = time.perf_counter()
+        for step, (ids, tgt) in zip(range(args.steps), batches):
+            params, opt_state, loss = step_fn(
+                params, opt_state, jnp.asarray(ids), jnp.asarray(tgt)
+            )
+            if step % args.log_every == 0 or step == args.steps - 1:
+                elapsed = time.perf_counter() - t0
+                tps = (step + 1) * args.batch_size * args.seq_len / elapsed
+                p50 = (
+                    float(np.median(list(model.moes[0].dispatch_times)) * 1000)
+                    if model.moes[0].dispatch_times
+                    else None
+                )
+                print(
+                    json.dumps(
+                        {
+                            "step": step,
+                            "loss": round(float(loss), 4),
+                            "tokens_per_sec": round(tps, 1),
+                            "dispatch_p50_ms": round(p50, 2) if p50 else None,
+                            "server_updates": sum(
+                                b.update_count
+                                for srv in servers
+                                for b in srv.experts.values()
+                            ),
+                        }
+                    ),
+                    flush=True,
+                )
+    finally:
+        for server in servers:
+            server.shutdown()
+        for dht in dhts:
+            dht.shutdown()
+        reset_client_rpc()
+
+
+def main():
+    args = parse_args()
+    if args.mode == "pod":
+        run_pod(args)
+    else:
+        run_swarm(args)
+
+
+if __name__ == "__main__":
+    main()

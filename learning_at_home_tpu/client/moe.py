@@ -42,6 +42,7 @@ from jax.experimental import io_callback
 from learning_at_home_tpu.client.routing import (
     CachedAliveSet,
     ExpertSource,
+    beam_search_alive,
     filter_valid_uids,
     make_uid,
     select_top_k,
@@ -90,7 +91,11 @@ class RemoteMixtureOfExperts:
         alive_ttl: float = 3.0,
         max_sessions: int = 1024,
         compute_dtype=jnp.float32,
+        routing: str = "enumerate",
+        beam_size: int = 8,
     ):
+        if routing not in ("enumerate", "beam"):
+            raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
         self.in_features = in_features
         self.grid_size = tuple(grid_size)
         self.n_dims = len(self.grid_size)
@@ -101,6 +106,9 @@ class RemoteMixtureOfExperts:
         self.forward_timeout = forward_timeout
         self.backward_timeout = backward_timeout
         self.compute_dtype = compute_dtype
+        self.routing = routing
+        self.beam_size = beam_size
+        self.source = source
         self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
         self._sessions: OrderedDict[int, dict] = OrderedDict()
         self._sessions_lock = threading.Lock()
@@ -202,10 +210,25 @@ class RemoteMixtureOfExperts:
             logits_concat[:, off : off + g]
             for off, g in zip(self._grid_offsets, self.grid_size)
         ]
-        alive = client_loop().run(self.alive_cache.get())
-        alive_uids = sorted(
-            filter_valid_uids(alive, self.uid_prefix, self.grid_size)
-        )
+        if self.routing == "beam":
+            # prefix beam search: fetch only the records for each sample's
+            # best first-dimension rows — scales to 4096-expert grids
+            # without ever reading the full top-level record
+            alive = client_loop().run(
+                beam_search_alive(
+                    self.source,
+                    self.uid_prefix,
+                    logits,
+                    self.grid_size,
+                    self.beam_size,
+                )
+            )
+            alive_uids = sorted(alive)
+        else:
+            alive = client_loop().run(self.alive_cache.get())
+            alive_uids = sorted(
+                filter_valid_uids(alive, self.uid_prefix, self.grid_size)
+            )
         if len(alive_uids) < self.k_min:
             raise MoEDispatchError(
                 f"only {len(alive_uids)} alive experts under prefix "
@@ -355,7 +378,13 @@ class RemoteMixtureOfExperts:
                 try:
                     tensors = task.result()
                 except Exception as e:
-                    logger.warning("%s RPC to %s failed: %s", msg_type, uid, e)
+                    logger.warning(
+                        "%s RPC to %s failed: %s: %s",
+                        msg_type,
+                        uid,
+                        type(e).__name__,
+                        e,
+                    )
                     continue
                 results[uid] = (*jobs[uid], tensors)
                 per_sample[rows_of[uid]] += 1

@@ -13,6 +13,7 @@ multi-dimensional gating.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Iterable, Optional, Protocol, Sequence
 
@@ -102,6 +103,40 @@ class StaticExpertSource:
         for p in prefixes:
             out[p] = any(self._matches(uid, p) for uid in self.experts)
         return out
+
+
+async def beam_search_alive(
+    source: "ExpertSource",
+    uid_prefix: str,
+    logits_per_dim: Sequence[np.ndarray],
+    grid_size: Sequence[int],
+    beam_size: int,
+) -> dict[str, Endpoint]:
+    """Find alive experts for a batch WITHOUT fetching the whole grid.
+
+    The reference walks DHT prefixes dimension-by-dimension per sample
+    (``first_k_active`` beam search).  Our record layout stores every alive
+    full uid under each prefix level, so one pruning step suffices: take
+    each sample's top ``beam_size`` first-dimension indices (union over the
+    batch), fetch those ``prefix.i`` records in parallel, and return the
+    union of alive experts found — a handful of small record fetches
+    instead of one giant top-level record for a 4096-expert grid.
+
+    Returns uid → endpoint for the candidate set (callers re-score exactly).
+    """
+    dim0 = logits_per_dim[0]  # [batch, grid_0]
+    width = min(beam_size, dim0.shape[1])
+    per_sample = np.argpartition(-dim0, width - 1, axis=1)[:, :width]
+    needed = np.unique(per_sample)
+    prefixes = [f"{uid_prefix}{UID_DELIMITER}{int(i)}" for i in needed]
+    records = await asyncio.gather(
+        *(source.get_alive_experts(p) for p in prefixes)
+    )
+    alive: dict[str, Endpoint] = {}
+    for rec in records:
+        alive.update(rec)
+    valid = set(filter_valid_uids(alive, uid_prefix, grid_size))
+    return {uid: ep for uid, ep in alive.items() if uid in valid}
 
 
 class CachedAliveSet:

@@ -161,6 +161,12 @@ class DHT:
         out = {}
         for uid, (v, _) in records.items():
             if uid == PLAIN_SUBKEY:
+                # the queried key IS a full expert uid (its own record) —
+                # happens for the deepest prefix level of 1-D grids, where
+                # beam search queries 'ffn.7' directly
+                endpoint = self._parse_endpoint(v)
+                if endpoint is not None:
+                    out[prefix] = endpoint
                 continue
             endpoint = self._parse_endpoint(v)
             if endpoint is not None:  # skip malformed peer-supplied values

@@ -26,6 +26,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from learning_at_home_tpu.models.trunk import causal_attention, layer_norm
 from learning_at_home_tpu.parallel.mesh import batch_sharding
 from learning_at_home_tpu.parallel.sharded_moe import ShardedMixtureOfExperts
 
@@ -117,32 +118,10 @@ class DMoETransformerLM:
 
     # ---- forward ----
 
-    def _ln(self, p, x):
-        x32 = x.astype(jnp.float32)
-        mu = x32.mean(-1, keepdims=True)
-        var = x32.var(-1, keepdims=True)
-        y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
-        return (y * p["scale"] + p["bias"]).astype(x.dtype)
-
-    def _attention(self, lp, x):
-        cfg = self.cfg
-        b, s, d = x.shape
-        h = cfg.n_heads
-        hd = d // h
-        q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s, h, hd)
-        k = (x @ lp["wk"].astype(x.dtype)).reshape(b, s, h, hd)
-        v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, h, hd)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        causal = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
-        return out @ lp["wo"].astype(x.dtype)
-
     def _layer(self, lp, x):
-        x = x + self._attention(lp, self._ln(lp["ln1"], x))
+        x = x + causal_attention(lp, layer_norm(lp["ln1"], x), self.cfg.n_heads)
         b, s, d = x.shape
-        moe_in = self._ln(lp["ln2"], x).reshape(b * s, d)
+        moe_in = layer_norm(lp["ln2"], x).reshape(b * s, d)
         moe_out, aux = self.moe(lp["moe"], moe_in)
         x = x + moe_out.reshape(b, s, d)
         return x, aux
@@ -159,7 +138,7 @@ class DMoETransformerLM:
         for lp in params["layers"]:
             x, aux = layer_fn(lp, x)
             aux_total = {k: aux_total[k] + aux[k] for k in aux_total}
-        x = self._ln(params["ln_f"], x)
+        x = layer_norm(params["ln_f"], x)
         head = (
             params["embed"].T if cfg.tie_embeddings else params["lm_head"]
         ).astype(jnp.float32)
@@ -176,6 +155,20 @@ class DMoETransformerLM:
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
         loss = ce + self.cfg.aux_loss_weight * aux["aux_loss"]
         return loss, {"ce": ce, **aux}
+
+    def init_opt_state(
+        self, optimizer: optax.GradientTransformation, params: Params
+    ):
+        """Optimizer state with correct shardings (expert stacks stay
+        expert-sharded; scalars replicated) — plain jit(opt.init) leaves
+        outputs on one device, which breaks restore + mixed-device steps."""
+        from learning_at_home_tpu.parallel.mesh import opt_state_shardings
+
+        abstract = jax.eval_shape(optimizer.init, params)
+        shardings = opt_state_shardings(
+            abstract, self.param_shardings(params), self.mesh
+        )
+        return jax.jit(optimizer.init, out_shardings=shardings)(params)
 
     def make_train_step(
         self, optimizer: optax.GradientTransformation

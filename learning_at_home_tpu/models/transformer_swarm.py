@@ -1,0 +1,123 @@
+"""Swarm-mode DMoE-Transformer: local trunk, network-remote expert FFNs.
+
+This is the reference's headline training setup (SURVEY.md §3.5): the
+trainer owns the embeddings/attention/gates and steps them with its own
+optimizer; every MoE FFN layer is a ``RemoteMixtureOfExperts`` whose
+experts live on DHT-discovered servers and update themselves
+asynchronously on each backward RPC.
+
+The remote dispatch rides ``io_callback`` under ``custom_vjp``
+(client/moe.py), so the whole step still jits on backends with
+host-callback support (CPU/GPU; the axon TPU plugin lacks callbacks — pod
+mode's ShardedMixtureOfExperts is the TPU path, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+from learning_at_home_tpu.client.routing import ExpertSource
+from learning_at_home_tpu.models.trunk import causal_attention, layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class SwarmTransformerConfig:
+    vocab_size: int = 258
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 8
+    seq_len: int = 128
+    grid_size: tuple = (16, 16)  # 256-expert grid, [BJ] config 3
+    k_best: int = 4
+    k_min: int = 1
+    backward_k_min: int = 1
+    uid_prefix: str = "ffn"
+    routing: str = "enumerate"
+    dtype: Any = jnp.float32
+
+
+class SwarmDMoETransformerLM:
+    """Trainer-side model; expert parameters never touch this process."""
+
+    def __init__(self, config: SwarmTransformerConfig, source: ExpertSource):
+        self.cfg = config
+        # one MoE layer object per transformer layer: layers may route to
+        # different uid prefixes (ffn0., ffn1., ...) so experts specialize
+        self.moes = [
+            RemoteMixtureOfExperts(
+                in_features=config.d_model,
+                grid_size=config.grid_size,
+                uid_prefix=f"{config.uid_prefix}{i}",
+                source=source,
+                k_best=config.k_best,
+                k_min=config.k_min,
+                backward_k_min=config.backward_k_min,
+                routing=config.routing,
+            )
+            for i in range(config.n_layers)
+        ]
+
+    def init_params(self, rng: jax.Array) -> Any:
+        cfg = self.cfg
+        d, v, s = cfg.d_model, cfg.vocab_size, cfg.seq_len
+        dense = jax.nn.initializers.lecun_normal()
+        embed_init = jax.nn.initializers.normal(1.0 / np.sqrt(d))
+        keys = iter(jax.random.split(rng, 3 + 6 * cfg.n_layers))
+
+        def ln():
+            return {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}
+
+        params = {
+            "embed": embed_init(next(keys), (v, d)),
+            "pos": embed_init(next(keys), (s, d)),
+            "ln_f": ln(),
+            "layers": [],
+        }
+        for i in range(cfg.n_layers):
+            params["layers"].append(
+                {
+                    "ln1": ln(),
+                    "wq": dense(next(keys), (d, d)),
+                    "wk": dense(next(keys), (d, d)),
+                    "wv": dense(next(keys), (d, d)),
+                    "wo": dense(next(keys), (d, d)),
+                    "ln2": ln(),
+                    "gate": self.moes[i].init_gate_params(next(keys)),
+                }
+            )
+        return params
+
+    def apply(self, params, token_ids):
+        b, s = token_ids.shape
+        x = params["embed"][token_ids] + params["pos"][None, :s]
+        for i, lp in enumerate(params["layers"]):
+            x = x + causal_attention(lp, layer_norm(lp["ln1"], x), self.cfg.n_heads)
+            moe_in = layer_norm(lp["ln2"], x).reshape(b * s, self.cfg.d_model)
+            moe_out = self.moes[i](moe_in, lp["gate"])
+            x = x + moe_out.reshape(b, s, self.cfg.d_model)
+        x = layer_norm(params["ln_f"], x)
+        return x @ params["embed"].T
+
+    def loss_fn(self, params, token_ids, targets):
+        logits = self.apply(params, token_ids)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    def make_train_step(self, optimizer: optax.GradientTransformation) -> Callable:
+        """Eager-host train step: local grads via jax.grad (backward RPCs
+        fire inside), optimizer on trunk+gates only."""
+
+        def step(params, opt_state, ids, targets):
+            loss, grads = jax.value_and_grad(self.loss_fn)(params, ids, targets)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return step

@@ -1,0 +1,37 @@
+"""Shared transformer trunk pieces used by both deployment modes.
+
+Pod mode (models/transformer.py, sharded MoE) and swarm mode
+(models/transformer_swarm.py, remote MoE) must stay numerically identical
+in everything but the FFN — LN epsilon, causal masking, attention math
+live HERE once so they cannot drift.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def layer_norm(p: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Pre-LN in float32, cast back to the input dtype."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def causal_attention(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
+    """Multi-head causal self-attention; softmax in float32."""
+    b, s, d = x.shape
+    hd = d // n_heads
+    q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s, n_heads, hd)
+    k = (x @ lp["wk"].astype(x.dtype)).reshape(b, s, n_heads, hd)
+    v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, n_heads, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+    return out @ lp["wo"].astype(x.dtype)

@@ -55,3 +55,27 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def expert_sharding(mesh: Mesh) -> NamedSharding:
     """Stacked per-expert params: leading axis split over 'expert'."""
     return NamedSharding(mesh, P("expert"))
+
+
+def opt_state_shardings(abstract_opt_state, param_shardings, mesh: Mesh):
+    """Shardings for an optimizer state mirroring the param tree.
+
+    Optimizer states (optax) embed sub-trees shaped like the params (mu/nu
+    in Adam); those leaves inherit the matching param's sharding — found by
+    matching each opt-state leaf's key-path SUFFIX against param key-paths.
+    Everything else (step counts, scalars) is replicated.  Needed because
+    ``jit(opt.init)`` does not propagate NamedShardings to its outputs, and
+    a checkpoint restored onto mismatched devices poisons the train step.
+    """
+    flat_params = jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+    param_map = {jax.tree_util.keystr(path): s for path, s in flat_params}
+    repl = NamedSharding(mesh, P())
+
+    def assign(path, leaf):
+        for i in range(len(path)):
+            suffix = jax.tree_util.keystr(path[i:])
+            if suffix in param_map:
+                return param_map[suffix]
+        return repl
+
+    return jax.tree_util.tree_map_with_path(assign, abstract_opt_state)

@@ -1,5 +1,17 @@
 from learning_at_home_tpu.server.expert_backend import ExpertBackend
 from learning_at_home_tpu.server.task_pool import TaskPool, BatchJob, bucket_rows
 from learning_at_home_tpu.server.runtime import Runtime
+from learning_at_home_tpu.server.chaos import ChaosConfig, ChaosInjector
+from learning_at_home_tpu.server.server import Server, background_server
 
-__all__ = ["ExpertBackend", "TaskPool", "BatchJob", "bucket_rows", "Runtime"]
+__all__ = [
+    "ExpertBackend",
+    "TaskPool",
+    "BatchJob",
+    "bucket_rows",
+    "Runtime",
+    "ChaosConfig",
+    "ChaosInjector",
+    "Server",
+    "background_server",
+]

@@ -1,0 +1,56 @@
+"""Chaos injection: emulate WAN latency, stragglers, and failures locally.
+
+The reference's experiment scripts inject latency and failures to emulate
+commodity-internet churn (SURVEY.md §5.3d, [BJ] config 4).  Here chaos is
+a server-side hook: every RPC reply can be delayed (base latency + jitter),
+turned into a straggler (long delay — exercises the client's
+``timeout_after_k_min`` grace path), or dropped (no reply — exercises the
+RPC timeout path).  Deterministic under a seed so experiments reproduce.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """All times in seconds; probabilities in [0, 1]."""
+
+    base_latency: float = 0.0  # added to every reply
+    jitter: float = 0.0  # uniform extra in [0, jitter]
+    straggler_prob: float = 0.0  # chance of a long stall instead
+    straggler_delay: float = 1.0
+    drop_prob: float = 0.0  # chance the reply is never sent
+    seed: Optional[int] = None
+
+    def make(self) -> "ChaosInjector":
+        return ChaosInjector(self)
+
+
+class ChaosInjector:
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self.rng = random.Random(config.seed)
+        self.injected_delays = 0
+        self.injected_stragglers = 0
+        self.injected_drops = 0
+
+    async def before_reply(self) -> bool:
+        """Apply chaos; returns False if the reply must be dropped."""
+        c = self.config
+        if c.drop_prob and self.rng.random() < c.drop_prob:
+            self.injected_drops += 1
+            return False
+        if c.straggler_prob and self.rng.random() < c.straggler_prob:
+            self.injected_stragglers += 1
+            await asyncio.sleep(c.straggler_delay)
+            return True
+        delay = c.base_latency + (self.rng.random() * c.jitter if c.jitter else 0.0)
+        if delay > 0:
+            self.injected_delays += 1
+            await asyncio.sleep(delay)
+        return True

@@ -52,6 +52,9 @@ class ConnectionHandler:
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break
                 reply = await self._dispatch(payload)
+                if self.server.chaos is not None:
+                    if not await self.server.chaos.before_reply():
+                        continue  # injected drop: client sees a timeout
                 await send_frame(writer, reply)
         except Exception:
             logger.exception("connection handler failed for peer %s", peer)

@@ -21,6 +21,7 @@ Runtime's one device-executor thread.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -66,6 +67,11 @@ class ExpertBackend:
             else jax.jit(optimizer.init)(self.params)
         )
         self.update_count = 0
+        # guards params/opt_state against torn reads: backward DONATES the
+        # old buffers, so a checkpoint snapshot racing an update would read
+        # invalidated arrays.  backward runs on the Runtime thread;
+        # state_dict may be called from any thread.
+        self._state_lock = threading.Lock()
 
         self._jit_forward = jax.jit(self._forward_impl)
         # params/opt_state donated: XLA reuses their HBM for the new state.
@@ -100,10 +106,11 @@ class ExpertBackend:
     ):
         """Return input-grads AND apply the async optimizer step in one XLA call."""
         grad_out = grad_outputs[0] if len(grad_outputs) == 1 else tuple(grad_outputs)
-        input_grads, self.params, self.opt_state = self._jit_backward(
-            self.params, self.opt_state, tuple(inputs), grad_out
-        )
-        self.update_count += 1
+        with self._state_lock:
+            input_grads, self.params, self.opt_state = self._jit_backward(
+                self.params, self.opt_state, tuple(inputs), grad_out
+            )
+            self.update_count += 1
         return jax.tree_util.tree_leaves(input_grads)
 
     # ---- metadata / checkpoint ----
@@ -121,13 +128,29 @@ class ExpertBackend:
 
     def state_dict(self) -> dict:
         """Host-side snapshot of params + opt state (for checkpointing)."""
-        return {
-            "params": jax.tree_util.tree_map(np.asarray, self.params),
-            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
-            "update_count": self.update_count,
-        }
+        with self._state_lock:
+            return {
+                "params": jax.tree_util.tree_map(np.asarray, self.params),
+                "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+                "update_count": self.update_count,
+            }
+
+    def state_template(self) -> dict:
+        """Shapes/dtypes of state_dict WITHOUT copying anything off-device
+        (restore template for checkpoint loading)."""
+
+        def to_sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        with self._state_lock:
+            return {
+                "params": jax.tree_util.tree_map(to_sds, self.params),
+                "opt_state": jax.tree_util.tree_map(to_sds, self.opt_state),
+                "update_count": 0,
+            }
 
     def load_state_dict(self, state: dict) -> None:
-        self.params = jax.device_put(state["params"])
-        self.opt_state = jax.device_put(state["opt_state"])
-        self.update_count = int(state.get("update_count", 0))
+        with self._state_lock:
+            self.params = jax.device_put(state["params"])
+            self.opt_state = jax.device_put(state["opt_state"])
+            self.update_count = int(state.get("update_count", 0))

@@ -49,10 +49,12 @@ class Server:
         dht: Any = None,
         update_period: float = 15.0,
         batch_timeout: float = 0.002,
+        chaos: Any = None,
     ):
         self.experts = dict(experts)
         self.host, self._requested_port = host, port
         self.dht = dht
+        self.chaos = chaos.make() if hasattr(chaos, "make") else chaos
         self.update_period = update_period
         self.runtime = Runtime()
         self.forward_pools: dict[str, TaskPool] = {}
@@ -119,6 +121,39 @@ class Server:
             except Exception:
                 logger.exception("declare_experts heartbeat failed")
             await asyncio.sleep(self.update_period)
+
+    # ---- checkpoint / resume (SURVEY.md §5.4) ----
+
+    def save_checkpoint(self, root: str, step: int = 0) -> None:
+        """Snapshot every expert's params+opt_state (safe during serving:
+        each snapshot serializes against that expert's async updates)."""
+        from learning_at_home_tpu.utils.checkpoint import (
+            mark_step_complete,
+            save_pytree,
+        )
+
+        for uid, backend in self.experts.items():
+            save_pytree(root, step, uid.replace("/", "_"), backend.state_dict())
+        mark_step_complete(root, step)
+        logger.info("checkpointed %d experts to %s @ step %d",
+                    len(self.experts), root, step)
+
+    def load_checkpoint(self, root: str, step: Optional[int] = None) -> int:
+        """Restore every hosted expert found in the checkpoint; returns the
+        step restored.  Recovery contract: restart → load → re-declare."""
+        from learning_at_home_tpu.utils.checkpoint import latest_step, restore_pytree
+
+        step = step if step is not None else latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps under {root}")
+        for uid, backend in self.experts.items():
+            state = restore_pytree(
+                root, step, uid.replace("/", "_"), backend.state_template()
+            )
+            backend.load_state_dict(state)
+        logger.info("restored %d experts from %s @ step %d",
+                    len(self.experts), root, step)
+        return step
 
     @property
     def endpoint(self) -> tuple[str, int]:

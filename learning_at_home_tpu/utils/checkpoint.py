@@ -1,0 +1,122 @@
+"""Checkpoint / resume: per-expert server state and pod-mode train state.
+
+The reference has at most periodic ``torch.save`` of each ExpertBackend
+(SURVEY.md §5.4 — low confidence, mount empty); recovery = restart from
+checkpoint and re-declare to the DHT.  This module is the parity-plus
+version the survey prescribes: orbax-backed pytree checkpoints that
+round-trip sharded arrays (pod mode) and per-expert state (swarm mode),
+with a simple step-numbered directory layout:
+
+    <root>/step_000123/<name>/...   (orbax per-pytree directories)
+
+``latest_step`` + ``restore_*`` give crash-resume; old steps can be
+pruned with ``keep_last``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+_STEP_RE = re.compile(r"^step_(\d{9})$")
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step:09d}")
+
+
+_COMPLETE_MARKER = ".complete"
+
+
+def mark_step_complete(root: str, step: int) -> None:
+    """Write the completion marker — call ONLY after every item of the step
+    is saved.  Without it the step is invisible to list_steps/latest_step,
+    so a crash mid-save can never be mistaken for a usable checkpoint."""
+    with open(os.path.join(_step_dir(root, step), _COMPLETE_MARKER), "w") as f:
+        f.write("ok")
+
+
+def list_steps(root: str, only_complete: bool = True) -> list[int]:
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _STEP_RE.match(name)
+        if m and (
+            not only_complete
+            or os.path.exists(os.path.join(root, name, _COMPLETE_MARKER))
+        ):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_step(root: str) -> Optional[int]:
+    steps = list_steps(root)
+    return steps[-1] if steps else None
+
+
+def save_pytree(root: str, step: int, name: str, tree: Any) -> str:
+    """Save one pytree under <root>/step_XXXXXXXXX/<name>."""
+    path = os.path.join(_step_dir(root, step), name)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), tree, force=True)
+    return path
+
+
+def restore_pytree(root: str, step: int, name: str, like: Any = None) -> Any:
+    """Restore; ``like`` (a pytree of arrays or ShapeDtypeStructs with
+    shardings) restores sharded arrays onto their meshes."""
+    path = os.path.abspath(os.path.join(_step_dir(root, step), name))
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is None:
+            return ckptr.restore(path)
+        def to_abstract(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            if hasattr(x, "dtype"):
+                return jax.ShapeDtypeStruct(
+                    np.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
+                )
+            return x  # plain python scalars (step counters etc.) pass through
+
+        abstract = jax.tree_util.tree_map(to_abstract, like)
+        return ckptr.restore(path, abstract)
+
+
+def prune_old_steps(root: str, keep_last: int) -> None:
+    steps = list_steps(root, only_complete=False)
+    complete = set(list_steps(root))
+    keep = set(sorted(complete)[-keep_last:]) if keep_last > 0 else complete
+    for step in steps:
+        if step not in keep:
+            shutil.rmtree(_step_dir(root, step), ignore_errors=True)
+
+
+class TrainCheckpointer:
+    """Pod-mode convenience: (params, opt_state, step) save/restore."""
+
+    def __init__(self, root: str, keep_last: int = 3):
+        self.root = root
+        self.keep_last = keep_last
+
+    def save(self, step: int, params: Any, opt_state: Any) -> None:
+        save_pytree(self.root, step, "params", params)
+        save_pytree(self.root, step, "opt_state", opt_state)
+        mark_step_complete(self.root, step)
+        prune_old_steps(self.root, self.keep_last)
+
+    def restore_latest(
+        self, params_like: Any, opt_state_like: Any
+    ) -> Optional[tuple[int, Any, Any]]:
+        step = latest_step(self.root)
+        if step is None:
+            return None
+        params = restore_pytree(self.root, step, "params", params_like)
+        opt_state = restore_pytree(self.root, step, "opt_state", opt_state_like)
+        return step, params, opt_state

@@ -109,7 +109,7 @@ def test_transformer_trains_and_keeps_shardings():
     model, cfg = _tiny_model(mesh)
     params = model.init_params(jax.random.PRNGKey(0))
     opt = optax.adamw(1e-3)
-    opt_state = jax.jit(opt.init)(params)
+    opt_state = model.init_opt_state(opt, params)
     step = model.make_train_step(opt)
 
     rs = np.random.RandomState(0)
