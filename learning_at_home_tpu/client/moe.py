@@ -49,6 +49,7 @@ from learning_at_home_tpu.client.routing import (
 )
 from learning_at_home_tpu.client.rpc import client_loop, pool_registry
 from learning_at_home_tpu.utils.connection import Endpoint
+from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)
 
@@ -200,6 +201,10 @@ class RemoteMixtureOfExperts:
     # ---- host side: forward fan-out with k-of-n quorum ----
 
     def _host_forward(self, x, logits_concat, store_session: bool = True):
+        with timeline.span(f"moe.dispatch.{self.uid_prefix}"):
+            return self._host_forward_impl(x, logits_concat, store_session)
+
+    def _host_forward_impl(self, x, logits_concat, store_session: bool = True):
         import time as _time
 
         t0 = _time.monotonic()

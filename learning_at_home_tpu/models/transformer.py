@@ -26,7 +26,12 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from learning_at_home_tpu.models.trunk import causal_attention, layer_norm
+from learning_at_home_tpu.models.trunk import (
+    causal_attention,
+    layer_norm,
+    output_projection,
+    qkv_projections,
+)
 from learning_at_home_tpu.parallel.mesh import batch_sharding
 from learning_at_home_tpu.parallel.sharded_moe import ShardedMixtureOfExperts
 
@@ -48,6 +53,10 @@ class DMoETransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     tie_embeddings: bool = True
+    # sequence/context parallelism: attention runs as a ring over the
+    # mesh's 'seq' axis (parallel/ring_attention.py).  The MoE stays
+    # data+expert sharded; XLA inserts the reshard at the boundary.
+    seq_parallel: bool = False
 
 
 class DMoETransformerLM:
@@ -65,6 +74,15 @@ class DMoETransformerLM:
             dtype=config.dtype,
             param_dtype=config.param_dtype,
         )
+        self._ring = None
+        if config.seq_parallel:
+            if "seq" not in mesh.axis_names:
+                raise ValueError("seq_parallel=True requires a 'seq' mesh axis")
+            from learning_at_home_tpu.parallel.ring_attention import (
+                make_ring_attention,
+            )
+
+            self._ring = make_ring_attention(mesh, causal=True)
 
     # ---- parameters ----
 
@@ -118,8 +136,15 @@ class DMoETransformerLM:
 
     # ---- forward ----
 
+    def _ring_attention(self, lp, x):
+        q, k, v = qkv_projections(lp, x, self.cfg.n_heads)
+        return output_projection(lp, self._ring(q, k, v))
+
     def _layer(self, lp, x):
-        x = x + causal_attention(lp, layer_norm(lp["ln1"], x), self.cfg.n_heads)
+        attn = self._ring_attention if self._ring is not None else (
+            lambda lp, x: causal_attention(lp, x, self.cfg.n_heads)
+        )
+        x = x + attn(lp, layer_norm(lp["ln1"], x))
         b, s, d = x.shape
         moe_in = layer_norm(lp["ln2"], x).reshape(b * s, d)
         moe_out, aux = self.moe(lp["moe"], moe_in)

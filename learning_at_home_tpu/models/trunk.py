@@ -22,16 +22,35 @@ def layer_norm(p: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
     return (y * p["scale"] + p["bias"]).astype(x.dtype)
 
 
-def causal_attention(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
-    """Multi-head causal self-attention; softmax in float32."""
+def qkv_projections(lp: dict, x: jax.Array, n_heads: int):
+    """Shared Q/K/V projections: [B,S,d] → three [B,S,H,hd]."""
     b, s, d = x.shape
     hd = d // n_heads
     q = (x @ lp["wq"].astype(x.dtype)).reshape(b, s, n_heads, hd)
     k = (x @ lp["wk"].astype(x.dtype)).reshape(b, s, n_heads, hd)
     v = (x @ lp["wv"].astype(x.dtype)).reshape(b, s, n_heads, hd)
+    return q, k, v
+
+
+def output_projection(lp: dict, out: jax.Array) -> jax.Array:
+    """[B,S,H,hd] → [B,S,d] @ wo."""
+    b, s, h, hd = out.shape
+    return out.reshape(b, s, h * hd) @ lp["wo"].astype(out.dtype)
+
+
+def causal_attention(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
+    """Multi-head causal self-attention; softmax in float32.
+
+    The ring-attention path (parallel/ring_attention.py) shares
+    :func:`qkv_projections` / :func:`output_projection` and replaces only
+    this dense score/softmax core with the ppermute ring + online softmax.
+    """
+    q, k, v = qkv_projections(lp, x, n_heads)
+    s = x.shape[1]
+    hd = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
     causal = jnp.tril(jnp.ones((s, s), bool))
     scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
-    return out @ lp["wo"].astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return output_projection(lp, out)

@@ -44,7 +44,10 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Tokens sharded across all data-bearing axes, features replicated."""
+    """Tokens sharded across all data-bearing axes; with a ``seq`` axis the
+    sequence dimension (axis 1) is context-parallel too."""
+    if "seq" in mesh.axis_names:
+        return NamedSharding(mesh, P(data_axes(mesh), "seq"))
     return NamedSharding(mesh, P(data_axes(mesh)))
 
 

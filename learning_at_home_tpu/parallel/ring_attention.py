@@ -1,0 +1,114 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7 — its scale axis
+is the expert dimension), but long-context is first-class in this
+framework: sequences longer than one chip's HBM are sharded over a ``seq``
+mesh axis, and attention runs as a ring — each device holds one Q chunk
+resident and streams K/V chunks around the ring with ``lax.ppermute``,
+accumulating output with the online-softmax (flash) recurrence.  Compute
+for chunk r overlaps the transfer of chunk r+1 on TPU (XLA schedules the
+collective-permute concurrently with the einsums).
+
+Memory per device: O(S_local * d + S_local^2 / n) instead of O(S^2);
+communication: n-1 permutes of the K/V chunk, bandwidth-optimal on a ring.
+
+Causal masking across chunks is by chunk index: a Q chunk attends fully to
+earlier K/V chunks, triangularly to its own, not at all to later ones —
+masked lanes still run (SPMD) but contribute -inf scores.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _online_softmax_update(o, l, m, scores, v_chunk):
+    """One flash-attention accumulation step.
+
+    o: [B, Sq, H, hd] running (unnormalized) output
+    l: [B, H, Sq]     running softmax denominator
+    m: [B, H, Sq]     running max
+    scores: [B, H, Sq, Sk]; v_chunk: [B, Sk, H, hd]
+    """
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])  # [B, H, Sq, Sk]
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_chunk)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, l_new, m_new
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call INSIDE shard_map.  q/k/v: [B, S_local, H, hd]; returns the local
+    output chunk [B, S_local, H, hd].
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, hd = q.shape
+    scale = 1.0 / np.sqrt(hd)
+
+    q32 = q.astype(jnp.float32)
+    o0 = jnp.zeros((b, s_local, h, hd), jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    tri = jnp.tril(jnp.ones((s_local, s_local), bool))
+
+    def body(r, carry):
+        o, l, m, kc, vc = carry
+        src = (my - r) % n  # which global chunk kc/vc currently is
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", q32, kc.astype(jnp.float32)) * scale
+        )
+        if causal:
+            full = src < my  # earlier chunk: attend to everything
+            diag = src == my  # own chunk: lower-triangular
+            mask = jnp.where(
+                full, True, jnp.where(diag, tri[None, None], False)
+            )
+            scores = jnp.where(mask, scores, -jnp.inf)
+        o, l, m = _online_softmax_update(o, l, m, scores, vc)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return o, l, m, kc, vc
+
+    o, l, m, _, _ = lax.fori_loop(0, n, body, (o0, l0, m0, k, v))
+    # fully-masked rows (can't happen with causal diag) would give l=0
+    denom = jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+    out = o / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "seq", causal: bool = True
+):
+    """shard_map-wrapped ring attention over global [B, S, H, hd] arrays
+    sharded on the sequence axis."""
+    spec = P(None, axis_name, None, None)
+
+    fn = shard_map(
+        functools.partial(
+            ring_attention_local, axis_name=axis_name, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn

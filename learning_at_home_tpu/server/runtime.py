@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from learning_at_home_tpu.server.task_pool import BatchJob
+from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)
 
@@ -73,7 +74,8 @@ class Runtime:
             self.queue_time += started - job.formed_at
             outputs, error = None, None
             try:
-                outputs = job.pool.process_fn(job.inputs)
+                with timeline.span(f"runtime.{job.pool.name}"):
+                    outputs = job.pool.process_fn(job.inputs)
                 # Materialize HERE, on the device thread: jit dispatch returns
                 # async arrays, and slicing them later on the event loop would
                 # block all networking until the device finishes.  This also

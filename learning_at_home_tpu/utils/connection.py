@@ -14,6 +14,7 @@ import asyncio
 import logging
 from typing import Optional, Sequence
 
+from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
     pack_message,
     recv_frame,
@@ -59,6 +60,10 @@ class ConnectionPool:
         ``timeout`` bounds the WHOLE exchange including connection
         establishment — a black-holed endpoint (dropped SYNs) must not stall
         the caller for the OS connect timeout."""
+        with timeline.span(f"rpc.{msg_type}"):
+            return await self._rpc_inner(msg_type, tensors, meta, timeout)
+
+    async def _rpc_inner(self, msg_type, tensors, meta, timeout):
         async with self._sem:
             writer = None
             try:

@@ -1,0 +1,94 @@
+"""Tracing / profiling: per-RPC timing spans + device trace hooks.
+
+The reference has nothing beyond logging and its benchmark scripts
+(SURVEY.md §5.1); the TPU build prescribes jax.profiler traces plus
+per-RPC timing spans.  This module provides both:
+
+- a process-wide :class:`Timeline` of timing spans (bounded ring buffer,
+  thread-safe, ~100ns overhead when disabled) used by the RPC client, the
+  task pools, and the MoE dispatcher;
+- :func:`device_trace`, a thin wrapper over ``jax.profiler.trace`` that
+  captures an XLA/TensorBoard trace directory for the jitted compute.
+
+Enable span collection with ``LAH_PROFILE=1`` in the environment or
+``timeline.enable()``; read results with ``timeline.summary()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class Timeline:
+    """Bounded, thread-safe collection of (name, start, duration) spans."""
+
+    def __init__(self, maxlen: int = 100_000):
+        self._spans: deque[tuple[str, float, float]] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.enabled = os.environ.get("LAH_PROFILE", "") not in ("", "0")
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def record(self, name: str, start: float, duration: float) -> None:
+        if self.enabled:
+            with self._lock:
+                self._spans.append((name, start, duration))
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.monotonic() - t0)
+
+    def spans(self, prefix: str = "") -> list[tuple[str, float, float]]:
+        with self._lock:
+            return [s for s in self._spans if s[0].startswith(prefix)]
+
+    def summary(self) -> dict[str, dict]:
+        """Per-span-name count / total / p50 / p99 (milliseconds)."""
+        groups: dict[str, list[float]] = defaultdict(list)
+        with self._lock:
+            for name, _, duration in self._spans:
+                groups[name].append(duration * 1000)
+        out = {}
+        for name, durs in groups.items():
+            arr = np.asarray(durs)
+            out[name] = {
+                "count": len(arr),
+                "total_ms": round(float(arr.sum()), 2),
+                "p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            }
+        return out
+
+
+timeline = Timeline()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler (XLA/TensorBoard) trace of the enclosed block."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
