@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""[BJ] config 1: a single local FFN ExpertBackend (hidden=1024) doing
+fwd/bwd on an MNIST-style task, no DHT.
+
+The reference's first milestone trains one expert through the full server
+runtime (TaskPool batching + Runtime device loop + async optimizer step on
+backward) on MNIST.  This sandbox has no network egress, so the dataset is
+a synthetic MNIST-like problem (28x28 images, 10 classes, class-dependent
+Gaussian blobs) — point ``--data path/to/mnist.npz`` (keys: x_train,
+y_train) at the real thing to reproduce exactly.
+
+The client side is intentionally primitive: it submits batches straight to
+the expert's pools, measuring steps/sec and batch-formation latency — the
+metrics BASELINE.md asks for.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def make_data(path, n, seed):
+    import numpy as np
+
+    if path:
+        blob = np.load(path)
+        x = blob["x_train"].reshape(len(blob["x_train"]), -1).astype(np.float32) / 255.0
+        y = blob["y_train"].astype(np.int32)
+        return x[:n], y[:n]
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(10, 784).astype(np.float32) * 0.5
+    y = rs.randint(0, 10, n).astype(np.int32)
+    x = centers[y] + rs.randn(n, 784).astype(np.float32) * 0.3
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", default=None, help="local mnist .npz (x_train,y_train)")
+    p.add_argument("--hidden-dim", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.server import ExpertBackend, Runtime, TaskPool
+
+    # classifier expert: 784 → hidden (FFN block) → 10 logits
+    import flax.linen as nn
+
+    class MnistExpert(nn.Module):
+        hidden: int
+
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(self.hidden)(x)
+            h = nn.gelu(h)
+            h = nn.LayerNorm()(h)
+            h = nn.Dense(self.hidden)(h)
+            h = nn.gelu(h)
+            return nn.Dense(10)(h)
+
+    module = MnistExpert(args.hidden_dim)
+    params = module.init(jax.random.PRNGKey(args.seed), jnp.zeros((2, 784)))
+    backend = ExpertBackend(
+        "mnist.0",
+        lambda p, x: module.apply(p, x),
+        params,
+        optax.adam(args.lr),
+        max_batch_size=max(1024, args.batch_size),
+    )
+
+    x_all, y_all = make_data(args.data, 60_000 if args.data else 20_000, args.seed)
+
+    async def run():
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        runtime.start()
+        fwd_pool = TaskPool(
+            backend.forward, "mnist.fwd", batch_timeout=0.001,
+            max_batch_size=backend.max_batch_size,
+        )
+        bwd_pool = TaskPool(
+            lambda t: backend.backward(t[:1], t[1:]), "mnist.bwd",
+            batch_timeout=0.001, max_batch_size=backend.max_batch_size,
+        )
+        fwd_pool.start(runtime)
+        bwd_pool.start(runtime)
+
+        rs = np.random.RandomState(args.seed)
+        t0 = time.perf_counter()
+        form_latencies = []
+        for step in range(args.steps):
+            idx = rs.randint(0, len(x_all), args.batch_size)
+            xb, yb = x_all[idx], y_all[idx]
+            t_submit = time.monotonic()
+            (logits,) = await fwd_pool.submit_task(xb)
+            form_latencies.append(time.monotonic() - t_submit)
+            # softmax CE grad wrt logits = p - onehot  (the "trainer" side)
+            p = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+            grad = (p - np.eye(10, dtype=np.float32)[yb]) / len(yb)
+            await bwd_pool.submit_task(xb, grad)
+            if step % 20 == 0 or step == args.steps - 1:
+                loss = float(-np.log(np.maximum(p[np.arange(len(yb)), yb], 1e-9)).mean())
+                acc = float((p.argmax(1) == yb).mean())
+                print(json.dumps({"step": step, "loss": round(loss, 4),
+                                  "acc": round(acc, 4)}), flush=True)
+        elapsed = time.perf_counter() - t0
+        runtime.shutdown()
+        print(json.dumps({
+            "metric": "config-1 single ExpertBackend MNIST",
+            "steps_per_sec": round(args.steps / elapsed, 2),
+            "batch_formation_p50_ms": round(float(np.median(form_latencies)) * 1000, 2),
+            "updates_applied": backend.update_count,
+        }), flush=True)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,23 @@
 from learning_at_home_tpu.ops.moe_dispatch import (
     DispatchPlan,
+    IndexDispatchPlan,
     combine_outputs,
+    combine_outputs_indexed,
     compute_capacity,
     dispatch_tokens,
+    dispatch_tokens_indexed,
     top_k_gating,
+    top_k_gating_indices,
 )
 
 __all__ = [
     "DispatchPlan",
+    "IndexDispatchPlan",
     "combine_outputs",
+    "combine_outputs_indexed",
     "compute_capacity",
     "dispatch_tokens",
+    "dispatch_tokens_indexed",
     "top_k_gating",
+    "top_k_gating_indices",
 ]

@@ -31,11 +31,61 @@ class DispatchPlan(NamedTuple):
     dropped_fraction: jax.Array  # [] fraction of (token, choice) pairs dropped
 
 
+class IndexDispatchPlan(NamedTuple):
+    """Compact index form of the same routing decision.
+
+    The one-hot [n, E, C] form burns O(n*E*C*d) MXU FLOPs on what is
+    really data movement; this form drives gathers/scatters instead:
+    O(E*C*d) for dispatch and O(n*k*d) for combine.
+    """
+
+    token_for_slot: jax.Array  # [E, C] int32 — source token per slot, -1 empty
+    slot_for_token: jax.Array  # [n, k] int32 — flat slot e*C+c per choice, -1 dropped
+    weights: jax.Array  # [n, k] float — renormalized gate weight per choice
+    aux_loss: jax.Array  # []
+    dropped_fraction: jax.Array  # []
+
+
 def compute_capacity(
     n_tokens: int, n_experts: int, k: int, capacity_factor: float = 1.25
 ) -> int:
     """Slots per expert so that on-balance routing fits with headroom."""
     return max(1, math.ceil(n_tokens * k * capacity_factor / n_experts))
+
+
+def _expert_positions(top_i: jax.Array, num_experts: int) -> jax.Array:
+    """Slot position of each (token, choice) within its chosen expert.
+
+    Token-order claims, counts carried across the k choices — THE slot
+    assignment both gating implementations share (identical by
+    construction, asserted by tests).  [n, k] int32.
+    """
+    n, k = top_i.shape
+    counts = jnp.zeros((num_experts,), jnp.int32)
+    cols = []
+    for j in range(k):  # k is small and static — unrolled at trace time
+        onehot = jax.nn.one_hot(top_i[:, j], num_experts, dtype=jnp.int32)
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]
+        cols.append(jnp.sum(pos_in_expert * onehot, axis=1))
+        counts = counts + jnp.sum(onehot, axis=0, dtype=jnp.int32)
+    return jnp.stack(cols, axis=1)
+
+
+def _load_balance_loss(gates: jax.Array, top_i: jax.Array) -> jax.Array:
+    """Shazeer/GShard auxiliary: E * <importance> . <top-1 load>."""
+    num_experts = gates.shape[1]
+    importance = gates.mean(axis=0)
+    load = jax.nn.one_hot(top_i[:, 0], num_experts, dtype=gates.dtype).mean(axis=0)
+    return num_experts * jnp.sum(importance * load)
+
+
+def _topk_weights(gates: jax.Array, k: int, renormalize: bool):
+    top_w, top_i = jax.lax.top_k(gates, k)
+    if renormalize:
+        top_w = top_w / jnp.maximum(
+            top_w.sum(axis=-1, keepdims=True), jnp.finfo(top_w.dtype).tiny
+        )
+    return top_w, top_i
 
 
 def top_k_gating(
@@ -50,37 +100,22 @@ def top_k_gating(
     """
     n, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)  # [n, E]
-    top_w, top_i = jax.lax.top_k(gates, k)  # [n, k]
-    if renormalize:
-        top_w = top_w / jnp.maximum(
-            top_w.sum(axis=-1, keepdims=True), jnp.finfo(top_w.dtype).tiny
-        )
+    top_w, top_i = _topk_weights(gates, k, renormalize)
+    pos = _expert_positions(top_i, num_experts)  # [n, k]
+    fits = pos < capacity
 
     combine = jnp.zeros((n, num_experts, capacity), gates.dtype)
     dispatch = jnp.zeros((n, num_experts, capacity), bool)
-    counts = jnp.zeros((num_experts,), jnp.int32)  # slots used so far
-    kept = jnp.zeros((), jnp.float32)
-
     for j in range(k):  # k is small and static — unrolled at trace time
-        onehot = jax.nn.one_hot(top_i[:, j], num_experts, dtype=jnp.int32)  # [n, E]
-        pos_in_expert = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]  # [n, E]
-        pos = jnp.sum(pos_in_expert * onehot, axis=1)  # [n]
-        fits = pos < capacity
-        slot_onehot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [n, C]
-        mask = (onehot.astype(gates.dtype))[:, :, None] * slot_onehot[:, None, :]
-        mask = mask * fits[:, None, None].astype(gates.dtype)
+        expert_onehot = jax.nn.one_hot(top_i[:, j], num_experts, dtype=gates.dtype)
+        slot_onehot = jax.nn.one_hot(pos[:, j], capacity, dtype=gates.dtype)
+        mask = expert_onehot[:, :, None] * slot_onehot[:, None, :]
+        mask = mask * fits[:, j][:, None, None].astype(gates.dtype)
         combine = combine + top_w[:, j][:, None, None] * mask
         dispatch = dispatch | (mask > 0)
-        counts = counts + jnp.sum(onehot, axis=0, dtype=jnp.int32)
-        kept = kept + jnp.sum(fits.astype(jnp.float32))
 
-    # Shazeer/GShard load-balance auxiliary: E * <importance> . <load>
-    importance = gates.mean(axis=0)  # [E]
-    load = (
-        jax.nn.one_hot(top_i[:, 0], num_experts, dtype=gates.dtype).mean(axis=0)
-    )
-    aux_loss = num_experts * jnp.sum(importance * load)
-    dropped = 1.0 - kept / (n * k)
+    aux_loss = _load_balance_loss(gates, top_i)
+    dropped = 1.0 - fits.sum().astype(jnp.float32) / (n * k)
     return DispatchPlan(combine, dispatch, aux_loss, dropped)
 
 
@@ -92,3 +127,52 @@ def dispatch_tokens(x: jax.Array, plan: DispatchPlan) -> jax.Array:
 def combine_outputs(y: jax.Array, plan: DispatchPlan) -> jax.Array:
     """Gather expert outputs back per token, gate-weighted: [E,C,d] → [n,d]."""
     return jnp.einsum("nec,ecd->nd", plan.combine.astype(y.dtype), y)
+
+
+def top_k_gating_indices(
+    logits: jax.Array, k: int, capacity: int, renormalize: bool = True
+) -> IndexDispatchPlan:
+    """Index-form routing: same semantics as :func:`top_k_gating`
+    (token-order slot claims, capacity dropping, renormalized weights)
+    without ever materializing [n, E, C] tensors."""
+    n, num_experts = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    top_w, top_i = _topk_weights(gates, k, renormalize)
+    pos = _expert_positions(top_i, num_experts)  # [n, k]
+    fits = pos < capacity
+
+    slot_for_token = jnp.where(
+        fits, top_i * capacity + pos, -1
+    ).astype(jnp.int32)
+    weights = jnp.where(fits, top_w, 0.0)
+
+    token_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, k))
+    token_for_slot = (
+        jnp.full((num_experts * capacity,), -1, jnp.int32)
+        .at[jnp.where(fits, slot_for_token, num_experts * capacity)]
+        .set(token_ids, mode="drop")
+        .reshape(num_experts, capacity)
+    )
+
+    aux_loss = _load_balance_loss(gates, top_i)
+    dropped = 1.0 - fits.sum().astype(jnp.float32) / (n * k)
+    return IndexDispatchPlan(token_for_slot, slot_for_token, weights, aux_loss, dropped)
+
+
+def dispatch_tokens_indexed(x: jax.Array, plan: IndexDispatchPlan) -> jax.Array:
+    """Gather-based dispatch: [n,d] → [E,C,d] with O(E*C*d) data movement."""
+    num_experts, capacity = plan.token_for_slot.shape
+    flat = plan.token_for_slot.reshape(-1)
+    rows = x[jnp.clip(flat, 0, None)]
+    rows = jnp.where((flat >= 0)[:, None], rows, 0)
+    return rows.reshape(num_experts, capacity, x.shape[-1])
+
+
+def combine_outputs_indexed(y: jax.Array, plan: IndexDispatchPlan) -> jax.Array:
+    """Gather-based combine: [E,C,d] → [n,d] with O(n*k*d) data movement."""
+    e, c, d = y.shape
+    y_flat = y.reshape(e * c, d)
+    slots = plan.slot_for_token  # [n, k]
+    picked = y_flat[jnp.clip(slots, 0, None)]  # [n, k, d]
+    # plan.weights is already zero wherever slots == -1 (set at plan build)
+    return jnp.einsum("nk,nkd->nd", plan.weights.astype(y.dtype), picked)

@@ -34,9 +34,12 @@ from jax import shard_map
 
 from learning_at_home_tpu.ops.moe_dispatch import (
     combine_outputs,
+    combine_outputs_indexed,
     compute_capacity,
     dispatch_tokens,
+    dispatch_tokens_indexed,
     top_k_gating,
+    top_k_gating_indices,
 )
 from learning_at_home_tpu.parallel.mesh import data_axes
 
@@ -64,7 +67,12 @@ class ShardedMixtureOfExperts:
         ffn_mult: int = 4,
         dtype: Any = jnp.bfloat16,
         param_dtype: Any = jnp.float32,
+        dispatch_impl: str = "gather",
     ):
+        if dispatch_impl not in ("gather", "onehot"):
+            raise ValueError(
+                f"dispatch_impl must be 'gather' or 'onehot', got {dispatch_impl!r}"
+            )
         if "expert" not in mesh.axis_names:
             raise ValueError("mesh must have an 'expert' axis")
         self.mesh = mesh
@@ -81,6 +89,10 @@ class ShardedMixtureOfExperts:
         self.ffn_dim = ffn_mult * hidden_dim
         self.dtype = dtype
         self.param_dtype = param_dtype
+        # 'gather' moves tokens with index gathers/scatters (O(E*C*d) data
+        # movement); 'onehot' uses the GShard-style [n,E,C] einsums
+        # (O(n*E*C*d) MXU work) — kept for comparison/fallback.
+        self.dispatch_impl = dispatch_impl
         self._shard = data_axes(mesh)  # axes the token batch is split over
 
     # ---- parameters ----
@@ -153,10 +165,12 @@ class ShardedMixtureOfExperts:
         logits = (x.astype(compute) @ params["gate"].astype(compute)).astype(
             jnp.float32
         )
-        plan = top_k_gating(logits, self.k, capacity)
-
-        # 2) scatter into capacity buckets and exchange over ICI
-        x_send = dispatch_tokens(x.astype(compute), plan)  # [E, C, d]
+        if self.dispatch_impl == "gather":
+            plan = top_k_gating_indices(logits, self.k, capacity)
+            x_send = dispatch_tokens_indexed(x.astype(compute), plan)
+        else:
+            plan = top_k_gating(logits, self.k, capacity)
+            x_send = dispatch_tokens(x.astype(compute), plan)  # [E, C, d]
         x_send = x_send.reshape(self.ep, e_local, capacity, d)
         x_recv = jax.lax.all_to_all(
             x_send, "expert", split_axis=0, concat_axis=0, tiled=False
@@ -178,7 +192,10 @@ class ShardedMixtureOfExperts:
         ).reshape(self.num_experts, capacity, d)
 
         # 5) gate-weighted combine for MY tokens
-        y = combine_outputs(y_recv, plan).astype(x.dtype)
+        if self.dispatch_impl == "gather":
+            y = combine_outputs_indexed(y_recv, plan).astype(x.dtype)
+        else:
+            y = combine_outputs(y_recv, plan).astype(x.dtype)
 
         axes = self._shard
         aux = {

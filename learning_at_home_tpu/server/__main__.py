@@ -1,0 +1,136 @@
+"""Standalone expert server CLI — the reference's ``Server.create`` entry
+point (SURVEY.md §3.3): start a peer hosting N experts, join the DHT swarm,
+declare + heartbeat, serve until interrupted.
+
+    python -m learning_at_home_tpu.server \
+        --num-experts 4 --expert-cls ffn --hidden-dim 1024 \
+        --expert-prefix ffn --port 31337 \
+        --initial-peers 10.0.0.1:31338 \
+        --checkpoint-dir ./ckpt --checkpoint-every 300
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import time
+
+
+def parse_endpoint(s: str) -> tuple[str, int]:
+    host, sep, port = s.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(
+            f"--initial-peers entry {s!r} must be host:port (e.g. 10.0.0.1:31337)"
+        )
+    return (host, int(port))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-experts", type=int, default=4)
+    p.add_argument("--expert-cls", default="ffn",
+                   choices=["ffn", "transformer", "nop"])
+    p.add_argument("--hidden-dim", type=int, default=1024)
+    p.add_argument("--expert-prefix", default="expert")
+    p.add_argument("--expert-offset", type=int, default=0,
+                   help="first expert index (partition a grid across servers)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--dht-port", type=int, default=0)
+    p.add_argument("--initial-peers", nargs="*", default=[],
+                   help="host:port of existing DHT peers")
+    p.add_argument("--no-dht", action="store_true")
+    p.add_argument("--update-period", type=float, default=15.0)
+    p.add_argument("--max-batch-size", type=int, default=1024)
+    p.add_argument("--optimizer", default="adam", choices=["adam", "sgd", "adamw"])
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=float, default=0.0,
+                   help="seconds between checkpoints (0 = only on shutdown)")
+    p.add_argument("--resume", action="store_true",
+                   help="load the latest checkpoint before serving")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.models import make_expert
+    from learning_at_home_tpu.server import ExpertBackend, Server
+
+    optimizer = {
+        "adam": optax.adam,
+        "adamw": optax.adamw,
+        "sgd": optax.sgd,
+    }[args.optimizer](args.lr)
+
+    experts = {}
+    for i in range(args.expert_offset, args.expert_offset + args.num_experts):
+        uid = f"{args.expert_prefix}.{i}"
+        apply_fn, params = make_expert(
+            args.expert_cls,
+            args.hidden_dim,
+            jax.random.PRNGKey(args.seed + i),
+            jnp.zeros((2, args.hidden_dim)),
+        )
+        experts[uid] = ExpertBackend(
+            uid, apply_fn, params, optimizer, max_batch_size=args.max_batch_size
+        )
+
+    dht = None
+    if not args.no_dht:
+        dht = DHT(
+            initial_peers=[parse_endpoint(s) for s in args.initial_peers],
+            port=args.dht_port,
+        )
+        print(f"DHT node at {dht.endpoint}", flush=True)
+
+    server = Server(
+        experts,
+        host=args.host,
+        port=args.port,
+        dht=dht,
+        update_period=args.update_period,
+    )
+    server.run_in_background()
+    ckpt_step = 0
+    if args.resume and args.checkpoint_dir:
+        try:
+            ckpt_step = server.load_checkpoint(args.checkpoint_dir)
+            print(f"resumed from checkpoint step {ckpt_step}", flush=True)
+        except FileNotFoundError:
+            print("no checkpoint found; starting fresh", flush=True)
+    print(
+        f"serving {len(experts)} {args.expert_cls!r} experts "
+        f"({sorted(experts)[0]}..{sorted(experts)[-1]}) on "
+        f"{server.endpoint[0]}:{server.endpoint[1]}",
+        flush=True,
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    last_ckpt = time.monotonic()
+    while not stop.wait(timeout=1.0):
+        if (
+            args.checkpoint_dir
+            and args.checkpoint_every > 0
+            and time.monotonic() - last_ckpt >= args.checkpoint_every
+        ):
+            ckpt_step += 1
+            server.save_checkpoint(args.checkpoint_dir, ckpt_step)
+            last_ckpt = time.monotonic()
+    if args.checkpoint_dir:
+        server.save_checkpoint(args.checkpoint_dir, ckpt_step + 1)
+        print("final checkpoint saved", flush=True)
+    server.shutdown()
+    if dht is not None:
+        dht.shutdown()
+    print("server shut down", flush=True)
+
+
+if __name__ == "__main__":
+    main()

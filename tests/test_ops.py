@@ -69,6 +69,61 @@ def test_dispatch_combine_roundtrip():
     np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
 
 
+def test_indexed_plan_matches_onehot():
+    """Index-form routing must agree with the one-hot reference exactly."""
+    from learning_at_home_tpu.ops import (
+        combine_outputs_indexed,
+        dispatch_tokens_indexed,
+        top_k_gating_indices,
+    )
+
+    rs = np.random.RandomState(7)
+    for n, E, k, cap in [(32, 8, 2, 6), (16, 4, 1, 2), (64, 8, 4, 16)]:
+        logits = jnp.asarray(rs.randn(n, E).astype(np.float32))
+        x = jnp.asarray(rs.randn(n, 8).astype(np.float32))
+        ref = top_k_gating(logits, k, cap)
+        idxp = top_k_gating_indices(logits, k, cap)
+        np.testing.assert_allclose(
+            float(idxp.dropped_fraction), float(ref.dropped_fraction), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(idxp.aux_loss), float(ref.aux_loss), atol=1e-5
+        )
+        buckets_ref = dispatch_tokens(x, ref)
+        buckets_idx = dispatch_tokens_indexed(x, idxp)
+        np.testing.assert_allclose(
+            np.asarray(buckets_idx), np.asarray(buckets_ref), atol=1e-6
+        )
+        y = jnp.asarray(rs.randn(E, cap, 8).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(combine_outputs_indexed(y, idxp)),
+            np.asarray(combine_outputs(y, ref)),
+            atol=1e-5,
+        )
+
+
+def test_indexed_gating_is_differentiable():
+    from learning_at_home_tpu.ops import (
+        combine_outputs_indexed,
+        dispatch_tokens_indexed,
+        top_k_gating_indices,
+    )
+
+    rs = np.random.RandomState(8)
+    x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+    w = jnp.asarray(rs.randn(8, 4).astype(np.float32) * 0.1)
+
+    def loss(w):
+        plan = top_k_gating_indices(x @ w, k=2, capacity=8)
+        return combine_outputs_indexed(
+            dispatch_tokens_indexed(x, plan), plan
+        ).sum() + plan.aux_loss
+
+    g = jax.grad(loss)(w)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
 def test_gating_is_differentiable():
     rs = np.random.RandomState(3)
     x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
