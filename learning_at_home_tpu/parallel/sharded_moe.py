@@ -77,6 +77,11 @@ class ShardedMixtureOfExperts:
             raise ValueError("mesh must have an 'expert' axis")
         self.mesh = mesh
         self.ep = mesh.shape["expert"]
+        # optional tensor parallelism: a 'model' mesh axis shards each
+        # expert's FFN dimension; the second einsum produces partial sums
+        # that one psum over 'model' reduces (Megatron-style column+row
+        # split, per expert)
+        self.tp = mesh.shape.get("model", 1)
         if num_experts % self.ep:
             raise ValueError(
                 f"num_experts={num_experts} must divide over expert axis "
@@ -87,6 +92,10 @@ class ShardedMixtureOfExperts:
         self.k = k
         self.capacity_factor = capacity_factor
         self.ffn_dim = ffn_mult * hidden_dim
+        if self.ffn_dim % self.tp:
+            raise ValueError(
+                f"ffn_dim={self.ffn_dim} must divide over model axis size {self.tp}"
+            )
         self.dtype = dtype
         self.param_dtype = param_dtype
         # 'gather' moves tokens with index gathers/scatters (O(E*C*d) data
@@ -110,14 +119,24 @@ class ShardedMixtureOfExperts:
         }
         return jax.device_put(params, self.param_shardings())
 
+    def _expert_param_specs(self) -> dict[str, P]:
+        if self.tp > 1:
+            return {
+                "w1": P("expert", None, "model"),  # column split
+                "b1": P("expert", "model"),
+                "w2": P("expert", "model", None),  # row split
+                "b2": P("expert"),
+            }
+        return {"w1": P("expert"), "b1": P("expert"),
+                "w2": P("expert"), "b2": P("expert")}
+
     def param_shardings(self) -> dict[str, NamedSharding]:
-        return {
-            "gate": NamedSharding(self.mesh, P()),
-            "w1": NamedSharding(self.mesh, P("expert")),
-            "b1": NamedSharding(self.mesh, P("expert")),
-            "w2": NamedSharding(self.mesh, P("expert")),
-            "b2": NamedSharding(self.mesh, P("expert")),
+        out = {
+            name: NamedSharding(self.mesh, spec)
+            for name, spec in self._expert_param_specs().items()
         }
+        out["gate"] = NamedSharding(self.mesh, P())
+        return out
 
     # ---- the sharded program ----
 
@@ -140,13 +159,7 @@ class ShardedMixtureOfExperts:
             functools.partial(self._local_forward, capacity=capacity),
             mesh=self.mesh,
             in_specs=(
-                {
-                    "gate": P(),
-                    "w1": P("expert"),
-                    "b1": P("expert"),
-                    "w2": P("expert"),
-                    "b2": P("expert"),
-                },
+                {"gate": P(), **self._expert_param_specs()},
                 P(self._shard),
             ),
             out_specs=(P(self._shard), {"aux_loss": P(), "dropped_fraction": P()}),
@@ -176,14 +189,20 @@ class ShardedMixtureOfExperts:
             x_send, "expert", split_axis=0, concat_axis=0, tiled=False
         )  # [ep, e_local, C, d] — slice j = tokens from expert-row peer j
 
-        # 3) batched expert FFN on the MXU (one einsum over the local stack)
+        # 3) batched expert FFN on the MXU (one einsum over the local stack).
+        # With tensor parallelism the FFN dim f is sharded over 'model':
+        # column-split w1 -> local activations, row-split w2 -> partial
+        # sums, one psum completes the contraction (Megatron pattern).
         xe = x_recv.transpose(1, 0, 2, 3).reshape(e_local, self.ep * capacity, d)
         w1 = params["w1"].astype(compute)
         b1 = params["b1"].astype(compute)
         w2 = params["w2"].astype(compute)
         b2 = params["b2"].astype(compute)
         h = jax.nn.gelu(jnp.einsum("egd,edf->egf", xe, w1) + b1[:, None, :])
-        ye = jnp.einsum("egf,efd->egd", h, w2) + b2[:, None, :]
+        ye = jnp.einsum("egf,efd->egd", h, w2)
+        if self.tp > 1:
+            ye = jax.lax.psum(ye, "model")
+        ye = ye + b2[:, None, :]
 
         # 4) return outputs to their source devices
         y_send = ye.reshape(e_local, self.ep, capacity, d).transpose(1, 0, 2, 3)

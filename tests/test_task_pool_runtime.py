@@ -133,6 +133,48 @@ def test_priority_oldest_first():
     run_pool(main())
 
 
+def test_batcher_property_randomized():
+    """Property test (SURVEY §5.2): under random task sizes, arrival jitter,
+    and pool parameters, every task gets EXACTLY its own rows back —
+    batching must never mix, drop, or reorder rows within a task."""
+    import random
+
+    rng = random.Random(0)
+    for trial in range(5):
+        max_bs = rng.choice([4, 8, 32])
+        timeout = rng.choice([0.0, 0.001, 0.01])
+
+        async def main():
+            def process(inputs):
+                # tag rows so misrouting is detectable: f(x) = x * 2 + 1
+                return [inputs[0] * 2 + 1]
+
+            pool = TaskPool(
+                process, "prop", max_batch_size=max_bs, batch_timeout=timeout
+            )
+            runtime = Runtime()
+            runtime.attach_loop(asyncio.get_running_loop())
+            runtime.start()
+            pool.start(runtime)
+
+            async def one_task(i):
+                n = rng.randint(1, max_bs)
+                # unique payload per task: task id in col 0, row id in col 1
+                x = np.stack(
+                    [np.full(n, i, np.float32), np.arange(n, dtype=np.float32)],
+                    axis=1,
+                )
+                if rng.random() < 0.5:
+                    await asyncio.sleep(rng.random() * 0.01)
+                (out,) = await pool.submit_task(x)
+                np.testing.assert_array_equal(out, x * 2 + 1)
+
+            await asyncio.gather(*(one_task(i) for i in range(40)))
+            runtime.shutdown()
+
+        run_pool(main())
+
+
 def test_many_concurrent_clients_stress():
     async def main():
         def process(inputs):
