@@ -36,6 +36,9 @@ class RemoteExpert:
     ``output_spec_fn(*input_specs) -> spec`` maps input ShapeDtypeStructs to
     the output spec (io_callback needs static result shapes); the default —
     output shaped like the first input — covers the standard expert blocks.
+    For pytree inputs the specs arrive in flattened (sorted-dict-key)
+    order, so pass an explicit ``output_spec_fn`` when the first leaf is
+    not output-shaped.
     """
 
     def __init__(
@@ -123,8 +126,14 @@ class RemoteExpert:
         return remote_call
 
     def __call__(self, *inputs):
-        """Jit/grad-compatible remote forward; backward RPCs on the vjp."""
-        return self._call(*inputs)
+        """Jit/grad-compatible remote forward; backward RPCs on the vjp.
+
+        Arguments may be arbitrary pytrees of arrays — they are flattened
+        to the wire's flat-tensor order (jax sorted-dict-key flattening,
+        matching the server's ``input_structure`` schema); gradients flow
+        back into the nest through jax's AD of the structure ops."""
+        leaves = jax.tree_util.tree_leaves(inputs)
+        return self._call(*leaves)
 
     def __repr__(self) -> str:
         return f"RemoteExpert({self.uid!r} @ {self.endpoint[0]}:{self.endpoint[1]})"

@@ -37,8 +37,11 @@ class ExpertBackend:
 
     Args:
         name: globally-unique expert UID (e.g. ``"ffn.4.17"``).
-        apply_fn: pure function ``(params, *inputs) -> output`` (single array
-            or tuple of arrays); typically ``flax_module.apply`` partial.
+        apply_fn: pure function.  Without ``input_structure``:
+            ``(params, *inputs) -> output`` over flat arrays.  With
+            ``input_structure``: ``(params, tree) -> output`` — the flat
+            wire tensors are repacked into ONE nest argument shaped like
+            ``input_structure`` before the call.
         params: initial parameter pytree (device or host).
         optimizer: an ``optax.GradientTransformation``.
         max_batch_size: upper bound on rows per executed batch; also the
@@ -54,11 +57,30 @@ class ExpertBackend:
         max_batch_size: int = 1024,
         opt_state: Any = None,
         n_inputs: int = 1,
+        input_structure: Any = None,
     ):
         self.name = name
         self.apply_fn = apply_fn
         self.optimizer = optimizer
         self.max_batch_size = max_batch_size
+        # pytree inputs (SURVEY §2 "Nested structures"): wire tensors are
+        # flat; an optional example structure repacks them into apply_fn's
+        # argument nest, and its schema travels in the info RPC so clients
+        # can flatten consistently.
+        self.input_structure = input_structure
+        if input_structure is not None:
+            from learning_at_home_tpu.utils.nested import nested_flatten
+
+            self._input_treedef = jax.tree_util.tree_structure(input_structure)
+            structure_arity = len(nested_flatten(input_structure))
+            if n_inputs != 1 and n_inputs != structure_arity:
+                raise ValueError(
+                    f"n_inputs={n_inputs} contradicts input_structure with "
+                    f"{structure_arity} leaves — pass only one of them"
+                )
+            n_inputs = structure_arity
+        else:
+            self._input_treedef = None
         self.n_inputs = n_inputs  # wire arity: tensors before grad_outputs
         self.params = jax.device_put(params)
         self.opt_state = (
@@ -79,12 +101,18 @@ class ExpertBackend:
 
     # ---- pure computations (jitted once per input-shape bucket) ----
 
-    def _forward_impl(self, params, inputs: tuple):
+    def _apply(self, params, inputs: tuple):
+        if self._input_treedef is not None:
+            tree = jax.tree_util.tree_unflatten(self._input_treedef, inputs)
+            return self.apply_fn(params, tree)
         return self.apply_fn(params, *inputs)
+
+    def _forward_impl(self, params, inputs: tuple):
+        return self._apply(params, inputs)
 
     def _backward_impl(self, params, opt_state, inputs: tuple, grad_outputs):
         outputs, vjp_fn = jax.vjp(
-            lambda p, xs: self.apply_fn(p, *xs), params, inputs
+            lambda p, xs: self._apply(p, xs), params, inputs
         )
         param_grads, input_grads = vjp_fn(grad_outputs)
         updates, new_opt_state = self.optimizer.update(
@@ -117,14 +145,20 @@ class ExpertBackend:
 
     def get_info(self) -> dict:
         """Serializable expert metadata (for the ``info`` RPC)."""
-        return {
+        info = {
             "name": self.name,
             "max_batch_size": self.max_batch_size,
+            "n_inputs": self.n_inputs,
             "num_params": int(
                 sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
             ),
             "update_count": self.update_count,
         }
+        if self.input_structure is not None:
+            from learning_at_home_tpu.utils.nested import schema_from_tree
+
+            info["input_schema"] = schema_from_tree(self.input_structure)
+        return info
 
     def state_dict(self) -> dict:
         """Host-side snapshot of params + opt state (for checkpointing)."""

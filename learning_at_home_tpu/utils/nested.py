@@ -46,3 +46,64 @@ def nested_map(fn, *trees: Any) -> Any:
 def nested_compare(t1: Any, t2: Any) -> bool:
     """True iff two nests share the same structure (leaf values ignored)."""
     return jax.tree_util.tree_structure(t1) == jax.tree_util.tree_structure(t2)
+
+
+# ---- wire-portable structure encoding -------------------------------------
+# treedefs aren't serializable across processes; this schema is: a small
+# msgpack-able description of dict/list/tuple nesting with leaf positions.
+
+
+def schema_from_tree(tree: Any) -> Any:
+    """Encode a nest's structure as plain msgpack-able data."""
+
+    from collections import OrderedDict
+
+    def encode(node):
+        if node is None:
+            return {"t": "n"}  # jax drops None from leaves
+        if isinstance(node, OrderedDict):
+            keys = list(node)  # jax flattens OrderedDict in insertion order
+            return {"t": "od", "k": keys, "c": [encode(node[k]) for k in keys]}
+        if isinstance(node, dict):
+            keys = sorted(node)  # jax flattens plain dicts in sorted-key order
+            return {"t": "d", "k": keys, "c": [encode(node[k]) for k in keys]}
+        if isinstance(node, tuple):
+            return {"t": "t", "c": [encode(x) for x in node]}
+        if isinstance(node, list):
+            return {"t": "l", "c": [encode(x) for x in node]}
+        return {"t": "x"}  # leaf
+
+    return encode(tree)
+
+
+def tree_from_schema(schema: Any, flat: Sequence[Any]) -> Any:
+    """Rebuild a nest from its schema and flat leaves (inverse pairing with
+    ``nested_flatten``, which uses jax's sorted-dict-key order)."""
+    from collections import OrderedDict
+
+    it = iter(flat)
+
+    def take_leaf():
+        try:
+            return next(it)
+        except StopIteration:
+            raise ValueError("too few leaves for schema") from None
+
+    def decode(node):
+        kind = node["t"]
+        if kind == "x":
+            return take_leaf()
+        if kind == "n":
+            return None
+        if kind in ("d", "od"):
+            # children were encoded in flatten order for their dict kind
+            pairs = [(k, decode(c)) for k, c in zip(node["k"], node["c"])]
+            return OrderedDict(pairs) if kind == "od" else dict(pairs)
+        children = [decode(c) for c in node["c"]]
+        return tuple(children) if kind == "t" else children
+
+    tree = decode(schema)
+    leftovers = sum(1 for _ in it)
+    if leftovers:
+        raise ValueError(f"{leftovers} extra leaves for schema")
+    return tree

@@ -1,0 +1,122 @@
+"""Pytree-input experts over the wire (SURVEY §2 'Nested structures')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_at_home_tpu.client import RemoteExpert, reset_client_rpc
+from learning_at_home_tpu.server import ExpertBackend, Server
+from learning_at_home_tpu.utils.nested import (
+    nested_flatten,
+    schema_from_tree,
+    tree_from_schema,
+)
+
+HID = 16
+
+
+def test_schema_roundtrip():
+    tree = {"b": (np.ones(2), [np.zeros(1)]), "a": np.ones(3)}
+    schema = schema_from_tree(tree)
+    leaves = nested_flatten(tree)
+    rebuilt = tree_from_schema(schema, leaves)
+    assert set(rebuilt) == {"a", "b"}
+    assert isinstance(rebuilt["b"], tuple) and isinstance(rebuilt["b"][1], list)
+    np.testing.assert_array_equal(rebuilt["a"], tree["a"])
+    with pytest.raises(ValueError, match="extra"):
+        tree_from_schema(schema, leaves + [np.ones(1)])
+    with pytest.raises(ValueError, match="too few"):
+        tree_from_schema(schema, leaves[:-1])
+
+
+def test_schema_ordereddict_and_none():
+    from collections import OrderedDict
+
+    # OrderedDict: insertion order must survive (jax flattens it that way)
+    od = OrderedDict([("x", np.ones(2)), ("a", np.zeros(3))])
+    leaves = nested_flatten(od)
+    rebuilt = tree_from_schema(schema_from_tree(od), leaves)
+    np.testing.assert_array_equal(rebuilt["x"], od["x"])
+    np.testing.assert_array_equal(rebuilt["a"], od["a"])
+    assert list(rebuilt) == ["x", "a"]
+
+    # None is structure, not a leaf
+    tree = {"a": np.ones(2), "b": None}
+    leaves = nested_flatten(tree)
+    assert len(leaves) == 1
+    rebuilt = tree_from_schema(schema_from_tree(tree), leaves)
+    assert rebuilt["b"] is None
+    np.testing.assert_array_equal(rebuilt["a"], tree["a"])
+
+
+def test_n_inputs_structure_contradiction():
+    import optax
+
+    with pytest.raises(ValueError, match="contradicts"):
+        ExpertBackend(
+            "bad",
+            lambda p, t: t,
+            {"w": jnp.ones(1)},
+            optax.sgd(0.1),
+            n_inputs=3,
+            input_structure={"a": np.zeros(1), "b": np.zeros(1)},
+        )
+
+
+@pytest.fixture(scope="module")
+def pytree_server():
+    # expert takes {"scale": [n,1], "x": [n,HID]} → x * scale @ W
+    def init(rng):
+        return {"w": jax.random.normal(rng, (HID, HID)) * 0.1}
+
+    def apply_fn(params, tree):
+        return (tree["x"] * tree["scale"]) @ params["w"]
+
+    structure = {"scale": np.zeros((1, 1)), "x": np.zeros((1, HID))}
+    backend = ExpertBackend(
+        "py.0",
+        apply_fn,
+        init(jax.random.PRNGKey(0)),
+        optax.sgd(0.01),
+        input_structure=structure,
+    )
+    server = Server({"py.0": backend}, host="127.0.0.1")
+    server.run_in_background()
+    yield server
+    server.shutdown()
+    reset_client_rpc()
+
+
+def test_pytree_expert_forward_and_grad(pytree_server):
+    srv = pytree_server
+    # leaves arrive in flattened (sorted-key) order: [scale, x]; the
+    # output is x-shaped, so point the spec at leaf 1
+    expert = RemoteExpert(
+        "py.0", srv.endpoint, output_spec_fn=lambda *specs: specs[1]
+    )
+    info = expert.info()
+    assert info["n_inputs"] == 2
+    assert info["input_schema"]["t"] == "d"
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, HID).astype(np.float32))
+    scale = jnp.asarray(rs.randn(4, 1).astype(np.float32))
+    tree = {"scale": scale, "x": x}
+
+    out = expert(tree)
+    params = srv.experts["py.0"].state_dict()["params"]
+    expected = (np.asarray(x) * np.asarray(scale)) @ params["w"]
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+    # grads flow back INTO the nest
+    def loss(tree):
+        return jnp.sum(expert(tree) ** 2)
+
+    g = jax.grad(loss)(tree)
+    assert set(g) == {"scale", "x"}
+    assert float(jnp.abs(g["x"]).sum()) > 0
+    assert float(jnp.abs(g["scale"]).sum()) > 0
+    # server applied its async update through the pytree backward
+    assert srv.experts["py.0"].update_count == 1
