@@ -52,6 +52,7 @@ class RemoteExpert:
         self.endpoint = (endpoint[0], int(endpoint[1]))
         self.timeout = timeout
         self.output_spec_fn = output_spec_fn or (lambda *specs: specs[0])
+        self._structure_checked = False
         self._call = self._build_custom_vjp()
 
     # ---- blocking host-side RPCs (also used by the MoE layer) ----
@@ -129,11 +130,31 @@ class RemoteExpert:
         """Jit/grad-compatible remote forward; backward RPCs on the vjp.
 
         Arguments may be arbitrary pytrees of arrays — they are flattened
-        to the wire's flat-tensor order (jax sorted-dict-key flattening,
-        matching the server's ``input_structure`` schema); gradients flow
-        back into the nest through jax's AD of the structure ops."""
+        to the wire's flat-tensor order (jax flattening), and on the first
+        nested call the client checks its structure against the server's
+        published ``input_schema`` so a flatten-order mismatch (e.g.
+        OrderedDict vs plain dict) fails loudly instead of silently
+        binding tensors to the wrong arguments."""
         leaves = jax.tree_util.tree_leaves(inputs)
+        if len(leaves) != len(inputs) and not self._structure_checked:
+            self._check_structure(inputs)
         return self._call(*leaves)
+
+    def _check_structure(self, inputs: tuple) -> None:
+        from learning_at_home_tpu.utils.nested import schema_from_tree
+
+        server_schema = self.info().get("input_schema")
+        if server_schema is not None:
+            client_tree = inputs[0] if len(inputs) == 1 else tuple(inputs)
+            client_schema = schema_from_tree(client_tree)
+            if client_schema != server_schema:
+                raise ValueError(
+                    f"input structure mismatch for expert {self.uid}: "
+                    f"client sends {client_schema}, server expects "
+                    f"{server_schema} — tensors would bind to the wrong "
+                    "arguments"
+                )
+        self._structure_checked = True
 
     def __repr__(self) -> str:
         return f"RemoteExpert({self.uid!r} @ {self.endpoint[0]}:{self.endpoint[1]})"

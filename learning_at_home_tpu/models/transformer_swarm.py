@@ -10,6 +10,14 @@ The remote dispatch rides ``io_callback`` under ``custom_vjp``
 (client/moe.py), so the whole step still jits on backends with
 host-callback support (CPU/GPU; the axon TPU plugin lacks callbacks — pod
 mode's ShardedMixtureOfExperts is the TPU path, SURVEY.md §2.2).
+
+Deployment note: run trainers and expert servers in SEPARATE processes
+(the normal swarm topology).  In one process they share one XLA runtime,
+and a trainer's blocking host callback can occupy the execution slot the
+server's own jitted expert computation needs — under concurrency that
+degenerates into stalls.  ``background_server`` in-process is fine for
+light tests; real training should talk to ``python -m
+learning_at_home_tpu.server`` peers.
 """
 
 from __future__ import annotations
@@ -41,6 +49,11 @@ class SwarmTransformerConfig:
     uid_prefix: str = "ffn"
     routing: str = "enumerate"
     dtype: Any = jnp.float32
+    # generous defaults: first-time XLA compiles per batch bucket happen
+    # inside the server's RPC window
+    forward_timeout: float = 60.0
+    backward_timeout: float = 60.0
+    timeout_after_k_min: float = 1.0
 
 
 class SwarmDMoETransformerLM:
@@ -60,6 +73,9 @@ class SwarmDMoETransformerLM:
                 k_min=config.k_min,
                 backward_k_min=config.backward_k_min,
                 routing=config.routing,
+                forward_timeout=config.forward_timeout,
+                backward_timeout=config.backward_timeout,
+                timeout_after_k_min=config.timeout_after_k_min,
             )
             for i in range(config.n_layers)
         ]

@@ -50,6 +50,10 @@ def main() -> None:
                    help="seconds between checkpoints (0 = only on shutdown)")
     p.add_argument("--resume", action="store_true",
                    help="load the latest checkpoint before serving")
+    p.add_argument("--warmup", type=int, nargs="*", default=None,
+                   help="pre-compile fwd/bwd for these batch-bucket sizes "
+                        "before serving (e.g. --warmup 64 256 1024); "
+                        "no value = all power-of-2 buckets")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -79,6 +83,18 @@ def main() -> None:
         experts[uid] = ExpertBackend(
             uid, apply_fn, params, optimizer, max_batch_size=args.max_batch_size
         )
+
+    if args.warmup is not None:
+        import numpy as np
+        import time as _t
+
+        t0 = _t.monotonic()
+        sample = [np.zeros((1, args.hidden_dim), np.float32)]
+        n = sum(
+            b.warmup(sample, buckets=args.warmup or None)
+            for b in experts.values()
+        )
+        print(f"warmed {n} programs in {_t.monotonic() - t0:.1f}s", flush=True)
 
     dht = None
     if not args.no_dht:

@@ -74,6 +74,14 @@ class ConnectionHandler:
             )
         try:
             if msg_type == "forward":
+                if len(tensors) != backend.n_inputs:
+                    # reject HERE: a wrong-arity task reaching the pool would
+                    # poison the whole formed batch (innocent co-batched
+                    # requests fail with it)
+                    raise ValueError(
+                        f"expert {uid} takes {backend.n_inputs} inputs, "
+                        f"got {len(tensors)}"
+                    )
                 outputs = await self.server.forward_pools[uid].submit_task(*tensors)
                 return pack_message("result", outputs)
             elif msg_type == "backward":

@@ -143,6 +143,51 @@ class ExpertBackend:
 
     # ---- metadata / checkpoint ----
 
+    def warmup(self, sample_inputs: Sequence[np.ndarray], buckets=None) -> int:
+        """Pre-compile forward and backward for the padded batch buckets.
+
+        XLA compiles one program per shape; without warmup the first
+        request of each bucket size compiles INSIDE its RPC window, which
+        on slow hosts reads as a dead expert to clients (and concurrent
+        client-side tracing in the same process can stall compiles for
+        minutes).  Call before declaring liveness; returns the number of
+        programs compiled.  ``sample_inputs``: one example row-batch per
+        input tensor (row count is replaced by each bucket size).
+        """
+        from learning_at_home_tpu.server.task_pool import bucket_rows
+
+        if buckets is None:
+            b = 1
+            buckets = []
+            while b < self.max_batch_size:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_batch_size)
+        # compile the buckets the RUNTIME will actually execute: requested
+        # sizes map through the same rounding the TaskPool applies
+        buckets = sorted({bucket_rows(b, self.max_batch_size) for b in buckets})
+        compiled = 0
+        for rows in buckets:
+            padded = tuple(
+                jax.ShapeDtypeStruct(
+                    (rows, *np.shape(t)[1:]), np.asarray(t).dtype
+                )
+                for t in sample_inputs
+            )
+            # AOT: lower + compile WITHOUT executing — no donation, no
+            # state mutation, programs land in the executable cache
+            self._jit_forward.lower(self.params, padded).compile()
+            out_aval = jax.eval_shape(self._forward_impl, self.params, padded)
+            leaves = jax.tree_util.tree_leaves(out_aval)
+            grad_out = (
+                leaves[0] if len(leaves) == 1 else tuple(leaves)
+            )
+            self._jit_backward.lower(
+                self.params, self.opt_state, padded, grad_out
+            ).compile()
+            compiled += 2
+        return compiled
+
     def get_info(self) -> dict:
         """Serializable expert metadata (for the ``info`` RPC)."""
         info = {

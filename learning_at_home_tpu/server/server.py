@@ -193,6 +193,11 @@ def background_server(
     Mirrors the reference's ``background_server`` fixture contract: yields
     ``(endpoint, server)``; tears down on exit.  Expert UIDs are
     ``{prefix}.{i}`` — grid-style UIDs for MoE tests come from the caller.
+
+    NB: this server shares the caller's XLA runtime.  For heavy training
+    loops (especially with client-side jax.grad through io_callbacks) use
+    a separate server process instead — see transformer_swarm.py's
+    deployment note.
     """
     from learning_at_home_tpu.models import make_expert
 

@@ -33,10 +33,12 @@ logger = logging.getLogger(__name__)
 
 
 def bucket_rows(n: int, max_batch_size: int) -> int:
-    """Smallest power-of-two ≥ n, clamped to max_batch_size."""
+    """Smallest power-of-two ≥ n, clamped to max_batch_size (the clamp also
+    covers non-power-of-two max_batch_size: 600 rows with max 1000 buckets
+    to 1000, never 1024)."""
     if n >= max_batch_size:
         return max_batch_size
-    return 1 << (n - 1).bit_length() if n > 1 else 1
+    return min(1 << (n - 1).bit_length(), max_batch_size) if n > 1 else 1
 
 
 @dataclass(order=True)

@@ -27,3 +27,11 @@ import jax  # noqa: E402  (already imported by sitecustomize; config still mutab
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# opt-in hang diagnosis: dump all thread stacks periodically
+if os.environ.get("LAH_DUMP_STACKS"):
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ["LAH_DUMP_STACKS"]), repeat=True, exit=False
+    )

@@ -89,6 +89,31 @@ def pytree_server():
     reset_client_rpc()
 
 
+def test_structure_mismatch_fails_loudly(pytree_server):
+    """A client whose nest flattens differently must get an error, not
+    silently swapped tensor bindings."""
+    from collections import OrderedDict
+
+    srv = pytree_server
+    expert = RemoteExpert("py.0", srv.endpoint, output_spec_fn=lambda *s: s[1])
+    x = jnp.ones((2, HID))
+    scale = jnp.ones((2, 1))
+    # insertion order x-then-scale ≠ server's sorted scale-then-x
+    bad = OrderedDict([("x", x), ("scale", scale)])
+    with pytest.raises(ValueError, match="structure mismatch"):
+        expert(bad)
+
+
+def test_wrong_forward_arity_rejected_cleanly(pytree_server):
+    """Wrong tensor count is rejected at the handler, not inside a batch."""
+    from learning_at_home_tpu.utils.connection import RemoteCallError
+
+    srv = pytree_server
+    expert = RemoteExpert("py.0", srv.endpoint)
+    with pytest.raises(RemoteCallError, match="takes 2 inputs"):
+        expert.forward_blocking([np.ones((2, HID), np.float32)])
+
+
 def test_pytree_expert_forward_and_grad(pytree_server):
     srv = pytree_server
     # leaves arrive in flattened (sorted-key) order: [scale, x]; the

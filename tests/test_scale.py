@@ -99,7 +99,7 @@ def test_multiprocess_server_roundtrip():
             try:
                 out = expert.forward_blocking([np.ones((2, 8), np.float32)])
                 break
-            except (OSError, RemoteCallError, Exception):
+            except (OSError, RemoteCallError):
                 if proc.poll() is not None:
                     raise AssertionError(
                         f"server died: {proc.stdout.read()[-2000:]}"
