@@ -24,7 +24,7 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--num-experts", type=int, default=16)
-    p.add_argument("--expert-cls", default="ffn", choices=["ffn", "nop", "transformer"])
+    p.add_argument("--expert-cls", default="ffn", choices=["ffn", "nop", "transformer", "swiglu"])
     p.add_argument("--hidden-dim", type=int, default=256)
     p.add_argument("--clients", type=int, default=16)
     p.add_argument("--requests", type=int, default=50, help="per client")

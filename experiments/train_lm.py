@@ -42,6 +42,10 @@ def parse_args():
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None, help="pod mode")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="steps between checkpoints (0 = end of run only)")
+    p.add_argument("--resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
 
@@ -77,18 +81,33 @@ def run_pod(args):
     opt_state = model.init_opt_state(optimizer, params)
     step_fn = model.make_train_step(optimizer)
 
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from learning_at_home_tpu.utils.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        if args.resume:
+            restored = ckpt.restore_latest(params, opt_state)
+            if restored is not None:
+                start_step, params, opt_state = restored
+                print(f"# resumed from step {start_step}", flush=True)
+
     tokens = load_corpus(args.data, seed=args.seed)
     batches = LMBatcher(tokens, args.batch_size, args.seq_len, seed=args.seed)
+    batches.skip(start_step)  # resume continues the data order, no replay
     sharding = batch_sharding(mesh)
 
     t0 = time.perf_counter()
-    for step, (ids, tgt) in zip(range(args.steps), batches):
+    for step, (ids, tgt) in zip(range(start_step, args.steps), batches):
         ids = jax.device_put(jnp.asarray(ids), sharding)
         tgt = jax.device_put(jnp.asarray(tgt), sharding)
         params, opt_state, loss, metrics = step_fn(params, opt_state, ids, tgt)
+        if ckpt and args.checkpoint_every and (step + 1) % args.checkpoint_every == 0:
+            ckpt.save(step + 1, params, opt_state)
         if step % args.log_every == 0 or step == args.steps - 1:
             elapsed = time.perf_counter() - t0
-            tps = (step + 1) * args.batch_size * args.seq_len / elapsed
+            tps = (step + 1 - start_step) * args.batch_size * args.seq_len / elapsed
             print(
                 json.dumps(
                     {
@@ -101,6 +120,9 @@ def run_pod(args):
                 ),
                 flush=True,
             )
+    if ckpt is not None:
+        ckpt.save(args.steps, params, opt_state)
+        print(f"# checkpointed final step {args.steps}", flush=True)
 
 
 def run_swarm(args):

@@ -10,7 +10,9 @@ expiry plus periodic re-declare IS the failure detector (SURVEY.md §5.3).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
+import time
 from typing import Any, Iterable, Optional, Sequence
 
 from learning_at_home_tpu.dht.protocol import (
@@ -43,6 +45,7 @@ class DHTNode:
         self.protocol = DHTProtocol(
             self.node_id, self.routing_table, self.storage, rpc_timeout
         )
+        self._maintenance_task: Optional[asyncio.Task] = None
 
     @classmethod
     async def create(
@@ -50,12 +53,15 @@ class DHTNode:
         host: str = "127.0.0.1",
         port: int = 0,
         initial_peers: Sequence[Endpoint] = (),
+        maintenance_period: Optional[float] = 60.0,
         **kwargs,
     ) -> "DHTNode":
         node = cls(**kwargs)
         await node.protocol.listen(host, port)
         if initial_peers:
             await node.bootstrap(initial_peers)
+        if maintenance_period:
+            node.start_maintenance(maintenance_period)
         return node
 
     @property
@@ -73,7 +79,52 @@ class DHTNode:
         await self.find_nearest_nodes(self.node_id)
 
     async def shutdown(self) -> None:
+        if self._maintenance_task is not None:
+            self._maintenance_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._maintenance_task
+            self._maintenance_task = None
         await self.protocol.shutdown()
+
+    # ---------------- table maintenance (refresh + stale eviction) ----------------
+
+    def start_maintenance(self, period: float = 60.0) -> None:
+        """Classic Kademlia hygiene: periodically (a) ping each bucket's
+        oldest peer and evict it if unresponsive twice (promoting a
+        replacement), (b) refresh buckets idle for a full period with a
+        lookup for a random ID in their range."""
+        if self._maintenance_task is not None:
+            self._maintenance_task.cancel()
+        self._maintenance_task = asyncio.get_running_loop().create_task(
+            self._maintain_forever(period), name="dht-maintenance"
+        )
+
+    async def _maintain_forever(self, period: float) -> None:
+        from learning_at_home_tpu.dht.routing import random_id_in_range
+
+        while True:
+            await asyncio.sleep(period)
+            try:
+                for bucket in list(self.routing_table.buckets):
+                    oldest = bucket.oldest
+                    if oldest is not None:
+                        nid, endpoint = oldest
+                        # two strikes: a single timed-out ping (GC pause,
+                        # transient congestion) must not shrink the table
+                        if (
+                            await self.protocol.call_ping(endpoint) is None
+                            and await self.protocol.call_ping(endpoint) is None
+                        ):
+                            self.routing_table.remove_node(nid)
+                    if bucket.peers and time.monotonic() - bucket.last_updated > period:
+                        await self.find_nearest_nodes(
+                            random_id_in_range(bucket.lower, bucket.upper)
+                        )
+                        bucket.last_updated = time.monotonic()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("DHT maintenance pass failed")
 
     # ---------------- iterative lookup core ----------------
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from typing import Iterable, Optional
 
 Endpoint = tuple[str, int]
@@ -53,13 +54,14 @@ class KBucket:
         self.lower, self.upper, self.k = lower, upper, k
         self.peers: dict[DHTID, Endpoint] = {}  # insertion-ordered = LRU
         self.replacement: dict[DHTID, Endpoint] = {}
-        self.last_updated = 0.0
+        self.last_updated = time.monotonic()
 
     def covers(self, node_id: int) -> bool:
         return self.lower <= node_id < self.upper
 
     def add_or_update(self, node_id: DHTID, endpoint: Endpoint) -> bool:
         """True if stored in the main slots, False if parked as replacement."""
+        self.last_updated = time.monotonic()  # live traffic = bucket not idle
         if node_id in self.peers:
             del self.peers[node_id]  # refresh LRU position
             self.peers[node_id] = endpoint
@@ -87,11 +89,19 @@ class KBucket:
     def split(self) -> tuple["KBucket", "KBucket"]:
         mid = (self.lower + self.upper) // 2
         left, right = KBucket(self.lower, mid, self.k), KBucket(mid, self.upper, self.k)
+        left.last_updated = right.last_updated = self.last_updated
         for nid, ep in self.peers.items():
             (left if left.covers(nid) else right).peers[nid] = ep
         for nid, ep in self.replacement.items():
             (left if left.covers(nid) else right).replacement[nid] = ep
         return left, right
+
+
+def random_id_in_range(lower: int, upper: int) -> DHTID:
+    """Uniform DHTID in [lower, upper) — bucket-refresh lookup targets."""
+    span = upper - lower
+    r = int.from_bytes(os.urandom((span.bit_length() + 7) // 8), "big") % span
+    return DHTID(lower + r)
 
 
 class RoutingTable:

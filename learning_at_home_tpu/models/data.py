@@ -86,6 +86,15 @@ class LMBatcher:
         self.seq_len = seq_len
         self.rng = np.random.RandomState(seed)
 
+    def skip(self, n_batches: int) -> None:
+        """Advance the RNG past n_batches draws WITHOUT materializing them —
+        resume must continue the uninterrupted run's data order, not replay
+        batches already trained on."""
+        for _ in range(n_batches):
+            self.rng.randint(
+                0, len(self.tokens) - self.seq_len - 1, size=self.batch_size
+            )
+
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         return self
 

@@ -30,7 +30,7 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--num-experts", type=int, default=4)
     p.add_argument("--expert-cls", default="ffn",
-                   choices=["ffn", "transformer", "nop"])
+                   choices=["ffn", "transformer", "swiglu", "nop"])
     p.add_argument("--hidden-dim", type=int, default=1024)
     p.add_argument("--expert-prefix", default="expert")
     p.add_argument("--expert-offset", type=int, default=0,

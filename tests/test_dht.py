@@ -154,6 +154,34 @@ def test_swarm_subkey_merge_from_different_writers():
     run(main())
 
 
+def test_maintenance_evicts_dead_peer_and_refreshes():
+    async def main():
+        a = await DHTNode.create(bucket_size=4, maintenance_period=None)
+        b = await DHTNode.create(
+            initial_peers=[a.endpoint], bucket_size=4, maintenance_period=None
+        )
+        c = await DHTNode.create(
+            initial_peers=[a.endpoint], bucket_size=4, maintenance_period=None
+        )
+        try:
+            assert len(b.routing_table) >= 2
+            await c.shutdown()  # c dies
+            b.start_maintenance(period=0.3)
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if b.routing_table.get_endpoint(c.node_id) is None:
+                    break
+                await asyncio.sleep(0.2)
+            assert b.routing_table.get_endpoint(c.node_id) is None, (
+                "dead peer never evicted by maintenance"
+            )
+            assert b.routing_table.get_endpoint(a.node_id) is not None
+        finally:
+            await teardown([a, b])
+
+    run(main())
+
+
 def test_node_failure_lookup_still_works():
     async def main():
         nodes = await make_swarm(6, bucket_size=4)

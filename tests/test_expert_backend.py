@@ -112,3 +112,17 @@ def test_state_dict_roundtrip(backend):
     (b,) = fresh.forward([x])
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
     assert fresh.update_count == 1
+
+
+def test_swiglu_expert_roundtrip():
+    """The swiglu zoo block serves and updates like the others."""
+    rng = jax.random.PRNGKey(1)
+    sample = jnp.zeros((2, HID))
+    apply_fn, params = make_expert("swiglu", HID, rng, sample)
+    be = ExpertBackend("sw.0", apply_fn, params, optax.sgd(0.01))
+    x = np.random.RandomState(0).randn(4, HID).astype(np.float32)
+    (out,) = be.forward([x])
+    assert out.shape == (4, HID)
+    (gx,) = be.backward([x], [np.ones((4, HID), np.float32)])
+    assert np.isfinite(np.asarray(gx)).all()
+    assert be.update_count == 1
