@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def layer_norm(p: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
@@ -39,18 +38,14 @@ def output_projection(lp: dict, out: jax.Array) -> jax.Array:
 
 
 def causal_attention(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
-    """Multi-head causal self-attention; softmax in float32.
-
-    The ring-attention path (parallel/ring_attention.py) shares
-    :func:`qkv_projections` / :func:`output_projection` and replaces only
-    this dense score/softmax core with the ppermute ring + online softmax.
+    """Multi-head causal self-attention via ``jax.nn.dot_product_attention``
+    (f32 softmax, 1/sqrt(hd) scale).  NB: jax 0.9's default implementation
+    still materializes the [B,H,S,S] scores — the API is used so future
+    jax releases/backends can substitute fused kernels, NOT for a memory
+    win today.  For sequences too long for O(S^2) memory use the ring path
+    (parallel/ring_attention.py), which shares :func:`qkv_projections` /
+    :func:`output_projection` and replaces only this dense core.
     """
     q, k, v = qkv_projections(lp, x, n_heads)
-    s = x.shape[1]
-    hd = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
     return output_projection(lp, out)
