@@ -44,7 +44,6 @@ from learning_at_home_tpu.client.routing import (
     ExpertSource,
     beam_search_alive,
     filter_valid_uids,
-    make_uid,
     select_top_k,
 )
 from learning_at_home_tpu.client.rpc import client_loop, pool_registry

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, Optional, Sequence
+from typing import Optional, Sequence
 
 from learning_at_home_tpu.dht.node import DHTNode
 from learning_at_home_tpu.dht.routing import DHTID, Endpoint

@@ -57,44 +57,22 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
-    import jax
-    import jax.numpy as jnp
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s: %(message)s"
+    )
+
     import optax
 
     from learning_at_home_tpu.dht import DHT
-    from learning_at_home_tpu.models import make_expert
-    from learning_at_home_tpu.server import ExpertBackend, Server
+    from learning_at_home_tpu.server import Server
 
     optimizer = {
         "adam": optax.adam,
         "adamw": optax.adamw,
         "sgd": optax.sgd,
     }[args.optimizer](args.lr)
-
-    experts = {}
-    for i in range(args.expert_offset, args.expert_offset + args.num_experts):
-        uid = f"{args.expert_prefix}.{i}"
-        apply_fn, params = make_expert(
-            args.expert_cls,
-            args.hidden_dim,
-            jax.random.PRNGKey(args.seed + i),
-            jnp.zeros((2, args.hidden_dim)),
-        )
-        experts[uid] = ExpertBackend(
-            uid, apply_fn, params, optimizer, max_batch_size=args.max_batch_size
-        )
-
-    if args.warmup is not None:
-        import numpy as np
-        import time as _t
-
-        t0 = _t.monotonic()
-        sample = [np.zeros((1, args.hidden_dim), np.float32)]
-        n = sum(
-            b.warmup(sample, buckets=args.warmup or None)
-            for b in experts.values()
-        )
-        print(f"warmed {n} programs in {_t.monotonic() - t0:.1f}s", flush=True)
 
     dht = None
     if not args.no_dht:
@@ -104,13 +82,28 @@ def main() -> None:
         )
         print(f"DHT node at {dht.endpoint}", flush=True)
 
-    server = Server(
-        experts,
+    if args.warmup is not None:
+        # True = all power-of-two buckets; a list = exactly those sizes
+        warmup = args.warmup if args.warmup else True
+    else:
+        warmup = False
+    server = Server.create(
+        num_experts=args.num_experts,
+        expert_cls=args.expert_cls,
+        hidden_dim=args.hidden_dim,
+        expert_prefix=args.expert_prefix,
+        expert_offset=args.expert_offset,
+        optimizer=optimizer,
+        max_batch_size=args.max_batch_size,
+        warmup=warmup,
+        seed=args.seed,
+        start=False,
         host=args.host,
         port=args.port,
         dht=dht,
         update_period=args.update_period,
     )
+    experts = server.experts
     server.run_in_background()
     ckpt_step = 0
     if args.resume and args.checkpoint_dir:

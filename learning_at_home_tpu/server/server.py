@@ -81,6 +81,61 @@ class Server:
 
     # ---- lifecycle ----
 
+    @classmethod
+    def create(
+        cls,
+        num_experts: int = 4,
+        expert_cls: str = "ffn",
+        hidden_dim: int = 1024,
+        expert_prefix: str = "expert",
+        expert_offset: int = 0,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        max_batch_size: int = 1024,
+        warmup=False,
+        seed: int = 0,
+        start: bool = True,
+        **server_kwargs,
+    ) -> "Server":
+        """Build a server from the expert zoo and (optionally) start it —
+        the reference's ``Server.create`` convenience (SURVEY.md §3.3).
+
+        Expert UIDs are ``{prefix}.{offset+i}``; partition a grid across
+        machines with ``expert_offset``.  ``warmup`` AOT-precompiles batch
+        buckets before returning (recommended for serving): ``True`` = all
+        power-of-two buckets, or a list of explicit bucket sizes."""
+        from learning_at_home_tpu.models import make_expert
+
+        optimizer = optimizer if optimizer is not None else optax.adam(1e-3)
+        experts = {}
+        for i in range(expert_offset, expert_offset + num_experts):
+            uid = f"{expert_prefix}.{i}"
+            apply_fn, params = make_expert(
+                expert_cls, hidden_dim, jax.random.PRNGKey(seed + i),
+                jnp.zeros((2, hidden_dim)),
+            )
+            experts[uid] = ExpertBackend(
+                uid, apply_fn, params, optimizer, max_batch_size=max_batch_size
+            )
+        if warmup:
+            import time as _time
+
+            import numpy as np
+
+            t0 = _time.monotonic()
+            sample = [np.zeros((1, hidden_dim), np.float32)]
+            buckets = None if warmup is True else list(warmup)
+            n = sum(
+                backend.warmup(sample, buckets=buckets)
+                for backend in experts.values()
+            )
+            logger.info(
+                "warmed %d programs in %.1fs", n, _time.monotonic() - t0
+            )
+        server = cls(experts, **server_kwargs)
+        if start:
+            server.run_in_background()
+        return server
+
     def run_in_background(self, await_ready: bool = True) -> "Server":
         assert self._loop is None, "server already started"
         self._loop = BackgroundLoop(name="lah-server")
@@ -199,20 +254,18 @@ def background_server(
     a separate server process instead — see transformer_swarm.py's
     deployment note.
     """
-    from learning_at_home_tpu.models import make_expert
-
-    optimizer = optimizer if optimizer is not None else optax.sgd(0.05)
-    experts = {}
-    for i in range(num_experts):
-        rng = jax.random.PRNGKey(seed + i)
-        sample = jnp.zeros((2, hidden_dim))
-        apply_fn, params = make_expert(expert_cls, hidden_dim, rng, sample)
-        uid = f"{expert_prefix}.{i}"
-        experts[uid] = ExpertBackend(
-            uid, apply_fn, params, optimizer, max_batch_size=max_batch_size
-        )
-    server = Server(experts, host="127.0.0.1", dht=dht, **server_kwargs)
-    server.run_in_background()
+    server = Server.create(
+        num_experts=num_experts,
+        expert_cls=expert_cls,
+        hidden_dim=hidden_dim,
+        expert_prefix=expert_prefix,
+        optimizer=optimizer if optimizer is not None else optax.sgd(0.05),
+        max_batch_size=max_batch_size,
+        seed=seed,
+        host="127.0.0.1",
+        dht=dht,
+        **server_kwargs,
+    )
     try:
         yield server.endpoint, server
     finally:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import threading
+import time
 from typing import Any, Awaitable, Callable, Optional
 
 
@@ -32,6 +33,32 @@ def run_in_background(fn: Callable, *args, daemon: bool = True, **kwargs) -> thr
     thread = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=daemon)
     thread.start()
     return thread
+
+
+def run_forever(
+    fn: Callable,
+    *args,
+    stop_event: Optional[threading.Event] = None,
+    **kwargs,
+) -> tuple[threading.Thread, threading.Event]:
+    """Run ``fn`` in a daemon thread, restarting it whenever it returns or
+    raises (keep-alive for watchdog-style loops).  Returns (thread, stop):
+    set ``stop`` to end the loop after the current iteration."""
+    import logging
+
+    logger = logging.getLogger(__name__)
+    stop = stop_event if stop_event is not None else threading.Event()
+
+    def loop() -> None:
+        while not stop.is_set():
+            try:
+                fn(*args, **kwargs)
+                logger.warning("run_forever target %r returned; restarting", fn)
+            except Exception:
+                logger.exception("run_forever target %r crashed; restarting", fn)
+            stop.wait(0.1)  # never busy-spin a crash loop
+
+    return run_in_background(loop), stop
 
 
 class BackgroundLoop:

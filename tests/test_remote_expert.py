@@ -118,3 +118,21 @@ def test_cross_client_batching(server):
         )
     formed = srv.forward_pools["expert.1"].batches_formed - formed_before
     assert formed < 16  # if batching broke, every request would form its own batch
+
+
+def test_server_create_classmethod():
+    """Server.create: zoo-built experts, optional warmup, full serve cycle."""
+    from learning_at_home_tpu.server import Server
+
+    srv = Server.create(
+        num_experts=2, hidden_dim=16, expert_prefix="zoo", host="127.0.0.1",
+        warmup=False,
+    )
+    try:
+        e = RemoteExpert("zoo.0", srv.endpoint)
+        x = np.random.RandomState(0).randn(2, 16).astype(np.float32)
+        (out,) = e.forward_blocking([x])
+        assert out.shape == (2, 16)
+        assert e.info()["name"] == "zoo.0"
+    finally:
+        srv.shutdown()

@@ -124,3 +124,25 @@ def test_timed_storage_maxsize(monkeypatch):
     assert len(store) == 2
     assert store.get("a") is None  # earliest-expiring evicted
     assert store.get("b") and store.get("c")
+
+
+def test_run_forever_restarts():
+    import threading
+    import time as _time
+
+    from learning_at_home_tpu.utils.asyncio_utils import run_forever
+
+    calls = []
+    done = threading.Event()
+
+    def flaky():
+        calls.append(1)
+        if len(calls) >= 3:
+            done.set()
+        raise RuntimeError("boom")
+
+    thread, stop = run_forever(flaky)
+    assert done.wait(timeout=10), f"only {len(calls)} calls"
+    stop.set()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
