@@ -5,12 +5,18 @@ from learning_at_home_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from learning_at_home_tpu.parallel.multihost import (
+    host_local_array_to_global,
+    initialize_multihost,
+)
 from learning_at_home_tpu.parallel.sharded_moe import ShardedMixtureOfExperts
 
 __all__ = [
     "batch_sharding",
     "data_axes",
     "expert_sharding",
+    "host_local_array_to_global",
+    "initialize_multihost",
     "make_mesh",
     "replicated",
     "ShardedMixtureOfExperts",

@@ -1,0 +1,76 @@
+"""Multi-host pod initialization: the DCN-tier bring-up for the ICI tier.
+
+A v5e-32 (or larger) slice spans multiple hosts; JAX exposes all chips as
+one device set once every process calls ``jax.distributed.initialize``.
+After :func:`initialize_multihost`, the existing mesh builders
+(``parallel.mesh.make_mesh``) operate over the GLOBAL device list and the
+sharded MoE / ring attention programs run unchanged — XLA routes the
+all_to_all/ppermute over ICI within the slice.
+
+This module is deliberately thin: the framework's cross-host *data plane*
+inside a pod IS XLA's (SURVEY.md §2.3 tier a); only process bring-up and
+per-host batch feeding are host code.  Anything OUTSIDE the pod slice
+keeps using the DHT + RPC tier (tier b).
+
+Typical launch (one process per host)::
+
+    initialize_multihost("10.0.0.1:9999", num_processes=4, process_id=i)
+    mesh = make_mesh({"data": 4, "expert": 8})       # 32 global chips
+    ids_local = next(batches)                         # this host's rows
+    ids = host_local_array_to_global(ids_local, mesh) # form the global batch
+
+``initialize_multihost`` itself needs real multiple processes and is not
+testable in this sandbox; the batch-assembly helper IS tested on the
+8-device virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learning_at_home_tpu.parallel.mesh import batch_sharding
+
+
+def initialize_multihost(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join this process to the pod's JAX distributed runtime.
+
+    Call ONCE per process before any other JAX API.  After it returns,
+    ``jax.devices()`` lists every chip in the slice and
+    ``jax.local_devices()`` this host's chips."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def host_local_array_to_global(
+    local_batch: np.ndarray, mesh: Mesh, spec: Optional[P] = None
+) -> jax.Array:
+    """Assemble per-host batch shards into one global sharded array.
+
+    Each host passes ITS rows; the default layout is exactly
+    ``batch_sharding(mesh)`` — the same sharding the train step expects
+    (including the sequence axis when the mesh has one), so no resharding
+    happens on step entry.
+
+    Constraint: the batch axes of the mesh must be process-major (build
+    the mesh with the batch-bearing axes FIRST, as in the examples) so
+    each process's local rows cover its addressable shards;
+    ``jax.make_array_from_process_local_data`` raises otherwise."""
+    sharding = (
+        NamedSharding(mesh, spec) if spec is not None else batch_sharding(mesh)
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_batch)
+    )

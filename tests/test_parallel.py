@@ -121,6 +121,27 @@ def test_capacity_drop_under_imbalance():
     assert (row_norms == 0).sum() >= 32
 
 
+def test_host_local_array_to_global():
+    from jax.sharding import PartitionSpec as P
+
+    from learning_at_home_tpu.parallel import host_local_array_to_global
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    x = np.arange(32, dtype=np.int32).reshape(16, 2)
+    g = host_local_array_to_global(x, mesh)
+    assert g.shape == (16, 2)
+    np.testing.assert_array_equal(np.asarray(g), x)
+    # default layout == batch_sharding == what the train step expects
+    assert g.sharding.spec == batch_sharding(mesh).spec
+    # seq-bearing mesh: sequence axis sharded too
+    mesh_sp = make_mesh({"data": 2, "expert": 2, "seq": 2})
+    g2 = host_local_array_to_global(np.ones((8, 4), np.float32), mesh_sp)
+    assert g2.sharding.spec == batch_sharding(mesh_sp).spec
+    # explicit override honored
+    g3 = host_local_array_to_global(x, mesh, spec=P("data"))
+    assert g3.sharding.spec == P("data")
+
+
 def _tiny_model(mesh, remat=False):
     cfg = DMoETransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=4, seq_len=16,
