@@ -75,6 +75,14 @@ def run_pod(args):
         k=args.k,
         dtype=jnp.bfloat16 if jax.devices()[0].platform != "cpu" else jnp.float32,
     )
+    from learning_at_home_tpu.parallel.mesh import data_axes
+
+    n_shards = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+    if args.batch_size % n_shards:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must be divisible by the "
+            f"{n_shards} batch shards of mesh {dict(mesh.shape)}"
+        )
     model = DMoETransformerLM(cfg, mesh)
     params = model.init_params(jax.random.PRNGKey(args.seed))
     optimizer = optax.adamw(args.lr)

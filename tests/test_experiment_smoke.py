@@ -1,0 +1,77 @@
+"""Benchmarks-as-tests (SURVEY §4): each experiment CLI must run end to end
+at tiny scale and emit its JSON — guards the scripts against bitrot."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_script(args, timeout=240):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, *args],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    return [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+
+
+@pytest.mark.slow
+def test_benchmark_dht_smoke():
+    (out,) = run_script(
+        ["experiments/benchmark_dht.py", "--nodes", "3", "--ops", "8"]
+    )
+    assert out["hit_rate"] == 1.0
+    assert out["store_ops_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_benchmark_throughput_smoke():
+    (out,) = run_script(
+        [
+            "experiments/benchmark_throughput.py",
+            "--num-experts", "1", "--clients", "2", "--requests", "2",
+            "--hidden-dim", "16", "--rows", "4",
+        ]
+    )
+    assert out["samples_per_sec"] > 0
+    assert out["batches_formed"] >= 1
+
+
+@pytest.mark.slow
+def test_mnist_expert_smoke():
+    lines = run_script(
+        [
+            "experiments/mnist_expert.py",
+            "--steps", "6", "--hidden-dim", "32", "--batch-size", "32",
+        ]
+    )
+    assert lines[-1]["updates_applied"] == 6
+    assert lines[-1]["steps_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_train_lm_pod_smoke():
+    lines = run_script(
+        [
+            "experiments/train_lm.py", "--mode", "pod", "--steps", "3",
+            "--num-experts", "8", "--batch-size", "8", "--d-model", "32",
+            "--seq-len", "16", "--log-every", "2",
+        ],
+        timeout=300,
+    )
+    assert lines and all("loss" in l for l in lines)
