@@ -3,34 +3,44 @@
 come back ([BJ] config 4; the reference's churn/latency simulation —
 SURVEY.md §2 'Experiment scripts', §5.3).
 
-Several expert servers host one grid; on a fixed schedule a server is
-killed (its DHT records expire → routing drops it) and later restarted
+Expert servers run as REAL separate processes (`python -m
+learning_at_home_tpu.server`) — the deployment topology; a trainer process
+must never share an XLA runtime with its servers (see
+models/transformer_swarm.py).  On a fixed schedule a server process is
+SIGTERMed (its DHT records expire → routing drops it) and later relaunched
 (it re-declares → routing picks it back up).  The trainer keeps stepping
-the whole time with k-of-n quorum; the script reports the loss curve,
-quorum failures, and effective alive-expert counts.
+with the k-of-n quorum; the script reports the loss curve, quorum
+failures, and alive-expert counts.
 
 Example:
-  python experiments/churn_experiment.py --steps 60 --kill-every 20
+  python experiments/churn_experiment.py --steps 40 --kill-every 10
 """
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--steps", type=int, default=60)
-    p.add_argument("--kill-every", type=int, default=20, help="steps between kills")
-    p.add_argument("--dead-for", type=int, default=10, help="steps a server stays dead")
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--kill-every", type=int, default=10, help="steps between kills")
+    p.add_argument("--dead-for", type=int, default=8, help="steps a server stays dead")
     p.add_argument("--n-servers", type=int, default=3)
     p.add_argument("--experts-per-server", type=int, default=2)
-    p.add_argument("--hidden-dim", type=int, default=32)
+    p.add_argument("--hidden-dim", type=int, default=16)
     p.add_argument("--batch-size", type=int, default=16)
-    p.add_argument("--ttl", type=float, default=1.0, help="expert record TTL (s)")
+    p.add_argument("--ttl", type=float, default=2.0, help="expert record TTL (s)")
+    p.add_argument("--max-down", type=int, default=1,
+                   help="max servers simultaneously dead-or-booting; kills "
+                        "beyond this wait (an operator preserves capacity)")
+    p.add_argument("--base-port", type=int, default=45160)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -40,39 +50,44 @@ def main():
     import optax
 
     from learning_at_home_tpu.client import reset_client_rpc
-    from learning_at_home_tpu.client.moe import MoEDispatchError, RemoteMixtureOfExperts
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
     from learning_at_home_tpu.dht import DHT
-    from learning_at_home_tpu.models import make_expert
-    from learning_at_home_tpu.server import ExpertBackend, Server
 
     n_experts = args.n_servers * args.experts_per_server
     bootstrap = DHT()
-    dhts = [bootstrap]
 
-    def make_server(server_idx: int) -> Server:
-        experts = {}
-        for i in range(n_experts):
-            if i % args.n_servers != server_idx:
-                continue
-            uid = f"churn.{i}"
-            apply_fn, params = make_expert(
-                "ffn",
-                args.hidden_dim,
-                jax.random.PRNGKey(1000 + i),
-                jnp.zeros((2, args.hidden_dim)),
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(REPO)
+
+    def launch_server(server_idx: int) -> subprocess.Popen:
+        """One server process hosting a contiguous block of the grid."""
+        log = open(f"/tmp/churn_srv{server_idx}.log", "ab")
+        try:
+            return subprocess.Popen(
+                [
+                    sys.executable, "-m", "learning_at_home_tpu.server",
+                    "--num-experts", str(args.experts_per_server),
+                    "--expert-offset", str(server_idx * args.experts_per_server),
+                    "--expert-prefix", "churn",
+                    "--hidden-dim", str(args.hidden_dim),
+                    "--port", str(args.base_port + server_idx),
+                    "--initial-peers",
+                    f"{bootstrap.endpoint[0]}:{bootstrap.endpoint[1]}",
+                    "--update-period", str(args.ttl / 2),
+                    "--warmup", str(args.batch_size),
+                    "--optimizer", "adam", "--lr", "1e-3",
+                    "--seed", str(args.seed + 100 * server_idx),
+                ],
+                env=env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
             )
-            experts[uid] = ExpertBackend(uid, apply_fn, params, optax.adam(1e-3))
-        dht = DHT(initial_peers=[bootstrap.endpoint])
-        dhts.append(dht)
-        server = Server(
-            experts, host="127.0.0.1", dht=dht, update_period=args.ttl / 2
-        )
-        server.run_in_background()
-        return server
+        finally:
+            log.close()  # Popen dup'd the fd; don't leak ours
 
-    servers: dict[int, Server] = {i: make_server(i) for i in range(args.n_servers)}
+    servers = {i: launch_server(i) for i in range(args.n_servers)}
     client_dht = DHT(initial_peers=[bootstrap.endpoint])
-    dhts.append(client_dht)
 
     moe = RemoteMixtureOfExperts(
         in_features=args.hidden_dim,
@@ -81,11 +96,9 @@ def main():
         source=client_dht,
         k_best=min(4, n_experts),
         k_min=1,
-        timeout_after_k_min=0.2,
-        # generous: first-time XLA compiles per batch bucket can take
-        # seconds; a short timeout misreads compiling experts as dead
-        forward_timeout=30.0,
-        backward_timeout=30.0,
+        timeout_after_k_min=0.25,
+        forward_timeout=20.0,
+        backward_timeout=20.0,
         alive_ttl=args.ttl / 2,
     )
     gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
@@ -97,80 +110,118 @@ def main():
     X = rs.randn(256, args.hidden_dim).astype(np.float32)
     Y = np.roll(X, 1, axis=1)
 
-    # wait for discovery
-    deadline = time.time() + 20
+    def alive_count() -> int:
+        return len(client_dht._loop.run(client_dht._get_alive("churn")))
+
+    deadline = time.time() + 180
     while time.time() < deadline:
-        if len(client_dht._loop.run(client_dht._get_alive("churn"))) == n_experts:
+        if alive_count() == n_experts:
             break
-        time.sleep(0.1)
+        time.sleep(0.5)
+    print(json.dumps({"event": "ready", "alive": alive_count()}), flush=True)
 
     def loss_fn(gate, x, y):
         return jnp.mean((moe(x, gate) - y) ** 2)
 
+    def server_uids(v: int) -> set:
+        base = v * args.experts_per_server
+        return {f"churn.{i}" for i in range(base, base + args.experts_per_server)}
+
     dead_since: dict[int, int] = {}
+    # a relaunched server counts as capacity again only when its experts are
+    # declared AND a full TTL has passed since relaunch — by then any records
+    # of the dying predecessor have expired, so the declarations are the new
+    # process's own (stale records must not read as "recovered")
+    restarting: dict[int, float] = {}  # v -> relaunch wall time
     quorum_failures = 0
     victim = 0
-    for step in range(args.steps):
-        # churn schedule
-        if args.kill_every and step > 0 and step % args.kill_every == 0:
-            v = victim % args.n_servers
-            if v not in dead_since and len(dead_since) < args.n_servers - 1:
-                servers[v].dht.shutdown()
-                servers[v].shutdown()
-                dead_since[v] = step
-                print(json.dumps({"event": "kill", "server": v, "step": step}), flush=True)
-            victim += 1
-        for v, since in list(dead_since.items()):
-            if step - since >= args.dead_for:
-                servers[v] = make_server(v)
-                del dead_since[v]
-                print(json.dumps({"event": "restart", "server": v, "step": step}), flush=True)
+    try:
+        for step in range(args.steps):
+            alive_uids = set(client_dht._loop.run(client_dht._get_alive("churn")))
+            for v, t_relaunch in list(restarting.items()):
+                if (
+                    time.time() - t_relaunch > args.ttl
+                    and server_uids(v) <= alive_uids
+                ):
+                    del restarting[v]
+                    print(json.dumps({"event": "recovered", "server": v,
+                                      "step": step}), flush=True)
+            if args.kill_every and step > 0 and step % args.kill_every == 0:
+                v = victim % args.n_servers
+                down = set(dead_since) | set(restarting)
+                if v not in down and len(down) < min(args.max_down, args.n_servers - 1):
+                    servers[v].terminate()
+                    dead_since[v] = step
+                    print(json.dumps({"event": "kill", "server": v, "step": step}),
+                          flush=True)
+                victim += 1
+            for v, since in list(dead_since.items()):
+                if step - since >= args.dead_for:
+                    try:
+                        servers[v].wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        servers[v].kill()  # SIGTERM ignored; force it
+                        servers[v].wait(timeout=30)
+                    servers[v] = launch_server(v)
+                    del dead_since[v]
+                    restarting[v] = time.time()
+                    print(json.dumps({"event": "relaunched", "server": v,
+                                      "step": step}), flush=True)
 
-        idx = rs.randint(0, len(X), args.batch_size)
-        x, y = jnp.asarray(X[idx]), jnp.asarray(Y[idx])
-        try:
-            loss, grads = jax.value_and_grad(loss_fn)(gate, x, y)
-            updates, opt_state = opt.update(grads, opt_state)
-            gate = optax.apply_updates(gate, updates)
-        except Exception as e:  # quorum failure: skip the batch, keep going
-            quorum_failures += 1
-            print(json.dumps({"event": "quorum_failure", "step": step,
-                              "error": str(e)[:80]}), flush=True)
-            time.sleep(0.25)
-            continue
-        if step % 5 == 0 or step == args.steps - 1:
-            alive = len(client_dht._loop.run(client_dht._get_alive("churn")))
-            print(
-                json.dumps(
-                    {
-                        "step": step,
-                        "loss": round(float(loss), 4),
-                        "alive_experts": alive,
-                        "dead_servers": sorted(dead_since),
-                        "quorum_failures": quorum_failures,
-                    }
-                ),
-                flush=True,
-            )
+            idx = rs.randint(0, len(X), args.batch_size)
+            x, y = jnp.asarray(X[idx]), jnp.asarray(Y[idx])
+            try:
+                loss, grads = jax.value_and_grad(loss_fn)(gate, x, y)
+                updates, opt_state = opt.update(grads, opt_state)
+                gate = optax.apply_updates(gate, updates)
+            except Exception as e:  # quorum failure: skip the batch, keep going
+                quorum_failures += 1
+                alive_now = sorted(
+                    client_dht._loop.run(client_dht._get_alive("churn"))
+                )
+                print(json.dumps({"event": "quorum_failure", "step": step,
+                                  "alive": alive_now,
+                                  "error": str(e)[-160:]}), flush=True)
+                time.sleep(0.25)
+                continue
+            if step % 5 == 0 or step == args.steps - 1:
+                print(
+                    json.dumps(
+                        {
+                            "step": step,
+                            "loss": round(float(loss), 4),
+                            "alive_experts": len(alive_uids),
+                            "dead_servers": sorted(set(dead_since) | set(restarting)),
+                            "quorum_failures": quorum_failures,
+                        }
+                    ),
+                    flush=True,
+                )
 
-    p50 = float(np.median(list(moe.dispatch_times)) * 1000)
-    print(
-        json.dumps(
-            {
-                "metric": "churn summary",
-                "steps": args.steps,
-                "quorum_failures": quorum_failures,
-                "quorum_success_rate": round(1 - quorum_failures / args.steps, 4),
-                "dispatch_p50_ms": round(p50, 2),
-            }
-        ),
-        flush=True,
-    )
-    for server in servers.values():
-        server.shutdown()
-    for dht in dhts:
-        dht.shutdown()
-    reset_client_rpc()
+        p50 = float(np.median(list(moe.dispatch_times)) * 1000)
+        print(
+            json.dumps(
+                {
+                    "metric": "churn summary",
+                    "steps": args.steps,
+                    "quorum_failures": quorum_failures,
+                    "quorum_success_rate": round(1 - quorum_failures / args.steps, 4),
+                    "dispatch_p50_ms": round(p50, 2),
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        for proc in servers.values():
+            proc.terminate()
+        for proc in servers.values():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        client_dht.shutdown()
+        bootstrap.shutdown()
+        reset_client_rpc()
 
 
 if __name__ == "__main__":

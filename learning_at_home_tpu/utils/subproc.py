@@ -1,0 +1,26 @@
+"""Environment recipe for spawning framework subprocesses.
+
+One place for the env-var scrubbing every spawned server/trainer process
+needs in this sandbox (and harmlessly elsewhere): force the CPU platform
+and drop ``PALLAS_AXON_POOL_IPS`` so the axon PJRT plugin's interpreter-
+startup ``register()`` never dials the TPU relay from a helper process.
+Used by experiment launchers and the subprocess-based tests alike.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def clean_jax_subprocess_env(
+    repo_root: Optional[str] = None, platform: str = "cpu"
+) -> dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = platform
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if repo_root:
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+    return env

@@ -70,12 +70,9 @@ def test_beam_search_4096_reads_few_records():
 @pytest.mark.slow
 def test_multiprocess_server_roundtrip():
     """Launch the server CLI as a REAL separate process and call it."""
-    env = dict(
-        os.environ,
-        PYTHONPATH=REPO,
-        JAX_PLATFORMS="cpu",
-    )
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(REPO)
     port = 43219
     proc = subprocess.Popen(
         [

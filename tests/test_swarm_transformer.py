@@ -40,9 +40,10 @@ def swarm():
 
     from learning_at_home_tpu.client import RemoteExpert
 
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = clean_jax_subprocess_env(repo)
     port = 43311
     proc = subprocess.Popen(
         [
