@@ -27,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def main():
+def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=40)
     p.add_argument("--kill-every", type=int, default=10, help="steps between kills")
@@ -42,7 +42,11 @@ def main():
                         "beyond this wait (an operator preserves capacity)")
     p.add_argument("--base-port", type=int, default=45160)
     p.add_argument("--seed", type=int, default=0)
-    args = p.parse_args()
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
 
     import jax
     import jax.numpy as jnp
@@ -52,12 +56,10 @@ def main():
     from learning_at_home_tpu.client import reset_client_rpc
     from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
     from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
 
     n_experts = args.n_servers * args.experts_per_server
     bootstrap = DHT()
-
-    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
-
     env = clean_jax_subprocess_env(REPO)
 
     def launch_server(server_idx: int) -> subprocess.Popen:
@@ -86,58 +88,62 @@ def main():
         finally:
             log.close()  # Popen dup'd the fd; don't leak ours
 
-    servers = {i: launch_server(i) for i in range(args.n_servers)}
-    client_dht = DHT(initial_peers=[bootstrap.endpoint])
-
-    moe = RemoteMixtureOfExperts(
-        in_features=args.hidden_dim,
-        grid_size=(n_experts,),
-        uid_prefix="churn",
-        source=client_dht,
-        k_best=min(4, n_experts),
-        k_min=1,
-        timeout_after_k_min=0.25,
-        forward_timeout=20.0,
-        backward_timeout=20.0,
-        alive_ttl=args.ttl / 2,
-    )
-    gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
-    opt = optax.adam(1e-2)
-    opt_state = opt.init(gate)
-
-    # toy regression task: y = roll(x); trains gate + experts jointly
-    rs = np.random.RandomState(args.seed)
-    X = rs.randn(256, args.hidden_dim).astype(np.float32)
-    Y = np.roll(X, 1, axis=1)
-
-    def alive_count() -> int:
-        return len(client_dht._loop.run(client_dht._get_alive("churn")))
-
-    deadline = time.time() + 180
-    while time.time() < deadline:
-        if alive_count() == n_experts:
-            break
-        time.sleep(0.5)
-    print(json.dumps({"event": "ready", "alive": alive_count()}), flush=True)
-
-    def loss_fn(gate, x, y):
-        return jnp.mean((moe(x, gate) - y) ** 2)
-
     def server_uids(v: int) -> set:
         base = v * args.experts_per_server
         return {f"churn.{i}" for i in range(base, base + args.experts_per_server)}
 
-    dead_since: dict[int, int] = {}
-    # a relaunched server counts as capacity again only when its experts are
-    # declared AND a full TTL has passed since relaunch — by then any records
-    # of the dying predecessor have expired, so the declarations are the new
-    # process's own (stale records must not read as "recovered")
-    restarting: dict[int, float] = {}  # v -> relaunch wall time
-    quorum_failures = 0
-    victim = 0
-    try:
+    servers: dict[int, subprocess.Popen] = {}
+    client_dht = None
+    try:  # EVERYTHING incl. launches/discovery: a setup failure or Ctrl-C
+        # must never orphan spawned server processes
+        for i in range(args.n_servers):
+            servers[i] = launch_server(i)
+        client_dht = DHT(initial_peers=[bootstrap.endpoint])
+
+        def get_alive() -> set:
+            return set(client_dht._loop.run(client_dht._get_alive("churn")))
+
+        moe = RemoteMixtureOfExperts(
+            in_features=args.hidden_dim,
+            grid_size=(n_experts,),
+            uid_prefix="churn",
+            source=client_dht,
+            k_best=min(4, n_experts),
+            k_min=1,
+            timeout_after_k_min=0.25,
+            forward_timeout=20.0,
+            backward_timeout=20.0,
+            alive_ttl=args.ttl / 2,
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(gate)
+
+        # toy regression task: y = roll(x); trains gate + experts jointly
+        rs = np.random.RandomState(args.seed)
+        X = rs.randn(256, args.hidden_dim).astype(np.float32)
+        Y = np.roll(X, 1, axis=1)
+
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if len(get_alive()) == n_experts:
+                break
+            time.sleep(0.5)
+        print(json.dumps({"event": "ready", "alive": len(get_alive())}), flush=True)
+
+        def loss_fn(gate, x, y):
+            return jnp.mean((moe(x, gate) - y) ** 2)
+
+        dead_since: dict[int, int] = {}
+        # a relaunched server counts as capacity again only when its experts
+        # are declared AND a full TTL has passed since relaunch — by then any
+        # records of the dying predecessor have expired, so the declarations
+        # are the new process's own
+        restarting: dict[int, float] = {}  # v -> relaunch wall time
+        quorum_failures = 0
+        victim = 0
         for step in range(args.steps):
-            alive_uids = set(client_dht._loop.run(client_dht._get_alive("churn")))
+            alive_uids = get_alive()
             for v, t_relaunch in list(restarting.items()):
                 if (
                     time.time() - t_relaunch > args.ttl
@@ -157,11 +163,14 @@ def main():
                 victim += 1
             for v, since in list(dead_since.items()):
                 if step - since >= args.dead_for:
+                    # SIGTERM went out dead_for steps ago; don't stall the
+                    # trainer on a hung shutdown — force and move on
+                    if servers[v].poll() is None:
+                        servers[v].kill()
                     try:
-                        servers[v].wait(timeout=30)
+                        servers[v].wait(timeout=10)
                     except subprocess.TimeoutExpired:
-                        servers[v].kill()  # SIGTERM ignored; force it
-                        servers[v].wait(timeout=30)
+                        continue  # un-reapable; retry next step
                     servers[v] = launch_server(v)
                     del dead_since[v]
                     restarting[v] = time.time()
@@ -176,11 +185,8 @@ def main():
                 gate = optax.apply_updates(gate, updates)
             except Exception as e:  # quorum failure: skip the batch, keep going
                 quorum_failures += 1
-                alive_now = sorted(
-                    client_dht._loop.run(client_dht._get_alive("churn"))
-                )
                 print(json.dumps({"event": "quorum_failure", "step": step,
-                                  "alive": alive_now,
+                                  "alive": sorted(get_alive()),  # at FAILURE time
                                   "error": str(e)[-160:]}), flush=True)
                 time.sleep(0.25)
                 continue
@@ -219,7 +225,8 @@ def main():
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        client_dht.shutdown()
+        if client_dht is not None:
+            client_dht.shutdown()
         bootstrap.shutdown()
         reset_client_rpc()
 

@@ -49,6 +49,7 @@ class DMoETransformerConfig:
     k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
+    router_z_weight: float = 1e-3  # ST-MoE router z-loss
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
@@ -159,7 +160,7 @@ class DMoETransformerLM:
         layer_fn = self._layer
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)
-        aux_total = {"aux_loss": 0.0, "dropped_fraction": 0.0}
+        aux_total = {"aux_loss": 0.0, "router_z_loss": 0.0, "dropped_fraction": 0.0}
         for lp in params["layers"]:
             x, aux = layer_fn(lp, x)
             aux_total = {k: aux_total[k] + aux[k] for k in aux_total}
@@ -178,7 +179,11 @@ class DMoETransformerLM:
     ) -> tuple[jax.Array, dict]:
         logits, aux = self.apply(params, token_ids)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
-        loss = ce + self.cfg.aux_loss_weight * aux["aux_loss"]
+        loss = (
+            ce
+            + self.cfg.aux_loss_weight * aux["aux_loss"]
+            + self.cfg.router_z_weight * aux["router_z_loss"]
+        )
         return loss, {"ce": ce, **aux}
 
     def init_opt_state(

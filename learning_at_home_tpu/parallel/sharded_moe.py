@@ -162,7 +162,10 @@ class ShardedMixtureOfExperts:
                 {"gate": P(), **self._expert_param_specs()},
                 P(self._shard),
             ),
-            out_specs=(P(self._shard), {"aux_loss": P(), "dropped_fraction": P()}),
+            out_specs=(
+                P(self._shard),
+                {"aux_loss": P(), "router_z_loss": P(), "dropped_fraction": P()},
+            ),
             check_vma=False,
         )
         return fn(params, x)
@@ -217,8 +220,12 @@ class ShardedMixtureOfExperts:
             y = combine_outputs(y_recv, plan).astype(x.dtype)
 
         axes = self._shard
+        # router z-loss (ST-MoE): penalizes logit magnitude so the softmax
+        # stays in a well-conditioned regime at scale
+        router_z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
         aux = {
             "aux_loss": jax.lax.pmean(plan.aux_loss, axes),
+            "router_z_loss": jax.lax.pmean(router_z, axes),
             "dropped_fraction": jax.lax.pmean(plan.dropped_fraction, axes),
         }
         return y, aux
