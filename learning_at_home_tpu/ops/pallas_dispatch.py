@@ -1,0 +1,107 @@
+"""Pallas TPU kernel for MoE token dispatch (experimental, opt-in).
+
+The gather-based dispatch (``ops/moe_dispatch.py``) already removed the
+one-hot einsum FLOPs; this kernel is the next rung — a hand-scheduled
+row-gather that PrefetchScalarGridSpec drives directly from the
+:class:`IndexDispatchPlan` indices, one grid step per expert slot:
+
+    x [n, d]  +  token_for_slot [E*C]  →  x_send [E*C, d]
+
+Each program DMAs its source token's row from HBM into VMEM and writes the
+output block (the Mosaic-lowerable pattern for dynamically-indexed HBM
+reads); empty slots write zeros.
+
+Status per SURVEY.md §7 M5: Pallas kernels are adopted on the hot path
+only once real-chip profiles show the dispatch dominating.  The kernel is
+equivalence-tested in interpret mode (CPU); native TPU compilation is
+UNVALIDATED this round (the chip tunnel was down — ROUND1_NOTES.md) and
+must be smoke-checked on hardware before adoption.  Use
+:func:`dispatch_tokens_auto` for the guarded entry point that falls back
+to the XLA gather whenever the kernel's constraints don't hold.
+
+Constraints for the kernel itself: ``d % 128 == 0`` (lane dimension).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from learning_at_home_tpu.ops.moe_dispatch import (
+    IndexDispatchPlan,
+    dispatch_tokens_indexed,
+)
+
+
+def _dispatch_kernel(idx_ref, x_hbm_ref, out_ref, row_vmem, dma_sem):
+    """One program per expert slot: DMA its source token's row (or zeros)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    slot = pl.program_id(0)
+    token = idx_ref[slot]
+
+    @pl.when(token >= 0)
+    def _copy():
+        dma = pltpu.make_async_copy(
+            x_hbm_ref.at[pl.ds(token, 1), :], row_vmem, dma_sem
+        )
+        dma.start()
+        dma.wait()
+        out_ref[...] = row_vmem[...]
+
+    @pl.when(token < 0)
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dispatch_tokens_pallas(
+    x: jax.Array, plan: IndexDispatchPlan, interpret: bool = False
+) -> jax.Array:
+    """Pallas scatter of tokens into capacity buckets: [n,d] → [E,C,d].
+
+    Equivalent to ``dispatch_tokens_indexed``; ``interpret=True`` runs the
+    kernel in the Pallas interpreter (CPU tests).  Raises on unsupported
+    shapes — see :func:`dispatch_tokens_auto` for the guarded wrapper."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_experts, capacity = plan.token_for_slot.shape
+    n, d = x.shape
+    if d % 128:
+        raise ValueError(f"pallas dispatch needs d % 128 == 0, got d={d}")
+    flat_idx = plan.token_for_slot.reshape(-1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # the slot→token index array
+        grid=(num_experts * capacity,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # x stays in HBM
+        out_specs=pl.BlockSpec((1, d), lambda i, idx_ref: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), x.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        _dispatch_kernel,
+        out_shape=jax.ShapeDtypeStruct((num_experts * capacity, d), x.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(flat_idx, x)
+    return out.reshape(num_experts, capacity, d)
+
+
+def dispatch_tokens_auto(
+    x: jax.Array,
+    plan: IndexDispatchPlan,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dispatch with graceful fallback: the Pallas kernel when requested AND
+    its constraints hold, otherwise the XLA gather."""
+    if use_pallas and x.shape[-1] % 128 == 0:
+        return dispatch_tokens_pallas(x, plan, interpret=interpret)
+    return dispatch_tokens_indexed(x, plan)
