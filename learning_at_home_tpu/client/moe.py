@@ -47,7 +47,6 @@ from learning_at_home_tpu.client.routing import (
     select_top_k,
 )
 from learning_at_home_tpu.client.rpc import client_loop, pool_registry
-from learning_at_home_tpu.utils.connection import Endpoint
 from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)

@@ -16,7 +16,7 @@ subword tokenizer can be slotted in via ``encode_fn``.
 from __future__ import annotations
 
 import os
-from typing import Callable, Iterator, Optional
+from typing import Iterator, Optional
 
 import numpy as np
 

@@ -23,7 +23,7 @@ learning_at_home_tpu.server`` peers.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Sequence
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp

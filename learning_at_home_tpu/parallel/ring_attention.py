@@ -20,7 +20,6 @@ masked lanes still run (SPMD) but contribute -inf scores.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

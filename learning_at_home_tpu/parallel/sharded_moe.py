@@ -25,7 +25,7 @@ dense, bfloat16-friendly: exactly what the MXU wants.
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -21,7 +21,7 @@ import os
 import threading
 import time
 from collections import defaultdict, deque
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

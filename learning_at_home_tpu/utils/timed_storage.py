@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import heapq
 import time
-from typing import Any, Generic, Hashable, Iterator, Optional, TypeVar
+from typing import Generic, Hashable, Iterator, Optional, TypeVar
 
 KeyType = TypeVar("KeyType", bound=Hashable)
 ValueType = TypeVar("ValueType")

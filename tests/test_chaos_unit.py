@@ -1,0 +1,66 @@
+"""Unit tests for the chaos injector itself (deterministic under a seed)."""
+
+import asyncio
+import time
+
+from learning_at_home_tpu.server.chaos import ChaosConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_drop_probability_and_counters():
+    inj = ChaosConfig(drop_prob=0.5, seed=1).make()
+
+    async def main():
+        delivered = 0
+        for _ in range(200):
+            delivered += await inj.before_reply()
+        return delivered
+
+    delivered = run(main())
+    assert 60 < delivered < 140  # ~binomial(200, 0.5)
+    assert inj.injected_drops == 200 - delivered
+
+
+def test_deterministic_under_seed():
+    async def outcomes(seed):
+        inj = ChaosConfig(drop_prob=0.3, straggler_prob=0.2, seed=seed).make()
+        return [await inj.before_reply() for _ in range(50)]
+
+    a = run(outcomes(7))
+    b = run(outcomes(7))
+    c = run(outcomes(8))
+    assert a == b
+    assert a != c  # different seed, different trace (overwhelmingly likely)
+
+
+def test_latency_and_straggler_delays():
+    async def main():
+        inj = ChaosConfig(base_latency=0.02, jitter=0.0, seed=0).make()
+        t0 = time.monotonic()
+        assert await inj.before_reply()
+        base_elapsed = time.monotonic() - t0
+        assert base_elapsed >= 0.02
+        assert inj.injected_delays == 1
+
+        stall = ChaosConfig(straggler_prob=1.0, straggler_delay=0.05, seed=0).make()
+        t0 = time.monotonic()
+        assert await stall.before_reply()
+        assert time.monotonic() - t0 >= 0.05
+        assert stall.injected_stragglers == 1
+
+    run(main())
+
+
+def test_noop_config_is_instant():
+    async def main():
+        inj = ChaosConfig().make()
+        t0 = time.monotonic()
+        for _ in range(100):
+            assert await inj.before_reply()
+        assert time.monotonic() - t0 < 0.5
+        assert inj.injected_delays == inj.injected_drops == 0
+
+    run(main())
