@@ -26,7 +26,7 @@ def test_drop_probability_and_counters():
 
 def test_deterministic_under_seed():
     async def outcomes(seed):
-        inj = ChaosConfig(drop_prob=0.3, straggler_prob=0.2, seed=seed).make()
+        inj = ChaosConfig(drop_prob=0.3, straggler_prob=0.2, straggler_delay=0.0, seed=seed).make()
         return [await inj.before_reply() for _ in range(50)]
 
     a = run(outcomes(7))
