@@ -99,9 +99,14 @@ def make_ring_attention(
 ):
     """shard_map-wrapped ring attention over global [B, S, H, hd] arrays
     sharded on the sequence axis."""
+    if axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no {axis_name!r} axis (axes: {mesh.axis_names})"
+        )
+    n_shards = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
 
-    fn = shard_map(
+    inner = shard_map(
         functools.partial(
             ring_attention_local, axis_name=axis_name, causal=causal
         ),
@@ -110,4 +115,17 @@ def make_ring_attention(
         out_specs=spec,
         check_vma=False,
     )
+
+    def fn(q, k, v):
+        if not (q.shape == k.shape == v.shape):
+            raise ValueError(
+                f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
+            )
+        if q.shape[1] % n_shards:
+            raise ValueError(
+                f"sequence length {q.shape[1]} must divide across the "
+                f"{n_shards} shards of mesh axis {axis_name!r}"
+            )
+        return inner(q, k, v)
+
     return fn

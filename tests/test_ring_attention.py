@@ -108,3 +108,18 @@ def test_ring_long_sequence_memory_shape():
     out = jax.jit(ring)(q, q, q)
     assert out.shape == (B, S, H, HD)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ring_validation_errors():
+    mesh = make_mesh({"seq": 8})
+    ring = make_ring_attention(mesh)
+    q = jnp.ones((1, 20, 2, 8))  # 20 % 8 != 0
+    with pytest.raises(ValueError, match="divide"):
+        ring(q, q, q)
+    with pytest.raises(ValueError, match="no 'nope' axis"):
+        make_ring_attention(mesh, axis_name="nope")
+    # mismatched k/v shapes must fail loudly too, not deep inside shard_map
+    q2 = jnp.ones((1, 40, 2, 8))
+    k2 = jnp.ones((1, 24, 2, 8))
+    with pytest.raises(ValueError, match="must match"):
+        ring(q2, k2, k2)
