@@ -21,6 +21,7 @@ Examples:
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -39,6 +40,12 @@ def parse_args():
     p.add_argument("--num-experts", type=int, default=256, help="pod mode")
     p.add_argument("--experts-per-layer", type=int, default=16, help="swarm mode")
     p.add_argument("--n-servers", type=int, default=2, help="swarm mode")
+    p.add_argument("--subprocess-servers", action="store_true",
+                   help="swarm mode: host experts in separate server "
+                        "processes (the production topology; required for "
+                        "heavy runs — a trainer must not share an XLA "
+                        "runtime with its servers)")
+    p.add_argument("--base-port", type=int, default=45200, help="swarm mode")
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--log-every", type=int, default=10)
@@ -149,32 +156,70 @@ def run_swarm(args):
     )
     from learning_at_home_tpu.server import ExpertBackend, Server
 
-    # grid: experts_per_layer experts in one dimension per layer
+    # grid: experts_per_layer experts in one dimension per layer; experts
+    # strided across servers
     grid = (args.experts_per_layer,)
     bootstrap = DHT()
-    servers, dhts = [], [bootstrap]
-    rng = np.random.RandomState(args.seed)
-    for s in range(args.n_servers):
-        experts = {}
-        for layer in range(args.n_layers):
-            for i in range(args.experts_per_layer):
-                if i % args.n_servers != s:
-                    continue  # experts partitioned across servers
-                uid = f"ffn{layer}.{i}"
+    servers, dhts, procs = [], [bootstrap], []
+
+    def uids_for_server(s: int) -> list[str]:
+        return [
+            f"ffn{layer}.{i}"
+            for layer in range(args.n_layers)
+            for i in range(args.experts_per_layer)
+            if i % args.n_servers == s
+        ]
+
+    if args.subprocess_servers:
+        import subprocess
+
+        from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = clean_jax_subprocess_env(repo)
+        for s in range(args.n_servers):
+            uids = uids_for_server(s)
+            if not uids:
+                continue  # more servers than experts: nothing to host
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "learning_at_home_tpu.server",
+                        "--expert-uids", ",".join(uids),
+                        "--hidden-dim", str(args.d_model),
+                        "--port", str(args.base_port + s),
+                        "--initial-peers",
+                        f"{bootstrap.endpoint[0]}:{bootstrap.endpoint[1]}",
+                        "--update-period", "5.0",
+                        "--optimizer", "adam", "--lr", str(args.lr),
+                        "--max-batch-size", "4096",
+                    ],
+                    env=env,
+                )
+            )
+    else:
+        import zlib
+
+        for s in range(args.n_servers):
+            uids = uids_for_server(s)
+            if not uids:
+                continue
+            experts = {}
+            for uid in uids:
+                # crc32 seeding: deterministic across runs AND identical to
+                # the subprocess path (hash() is salted per interpreter)
+                key = jax.random.PRNGKey(zlib.crc32(uid.encode()) & 0x7FFFFFFF)
                 apply_fn, params = make_expert(
-                    "ffn",
-                    args.d_model,
-                    jax.random.PRNGKey(hash((layer, i)) % (1 << 31)),
-                    jnp.zeros((2, args.d_model)),
+                    "ffn", args.d_model, key, jnp.zeros((2, args.d_model))
                 )
                 experts[uid] = ExpertBackend(
                     uid, apply_fn, params, optax.adam(args.lr), max_batch_size=4096
                 )
-        dht = DHT(initial_peers=[bootstrap.endpoint])
-        dhts.append(dht)
-        server = Server(experts, host="127.0.0.1", dht=dht, update_period=5.0)
-        server.run_in_background()
-        servers.append(server)
+            dht = DHT(initial_peers=[bootstrap.endpoint])
+            dhts.append(dht)
+            server = Server(experts, host="127.0.0.1", dht=dht, update_period=5.0)
+            server.run_in_background()
+            servers.append(server)
     client_dht = DHT(initial_peers=[bootstrap.endpoint])
     dhts.append(client_dht)
 
@@ -182,6 +227,12 @@ def run_swarm(args):
     want = args.n_layers * args.experts_per_layer
     deadline = time.time() + 30
     while time.time() < deadline:
+        for proc in procs:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"server process exited with {proc.returncode} during "
+                    "startup (port in use? see its log)"
+                )
         found = sum(
             len(client_dht._loop.run(client_dht._get_alive(f"ffn{l}")))
             for l in range(args.n_layers)
@@ -229,10 +280,14 @@ def run_swarm(args):
                             "loss": round(float(loss), 4),
                             "tokens_per_sec": round(tps, 1),
                             "dispatch_p50_ms": round(p50, 2) if p50 else None,
-                            "server_updates": sum(
-                                b.update_count
-                                for srv in servers
-                                for b in srv.experts.values()
+                            "server_updates": (
+                                sum(
+                                    b.update_count
+                                    for srv in servers
+                                    for b in srv.experts.values()
+                                )
+                                if servers
+                                else None  # remote processes: see info RPC
                             ),
                         }
                     ),
@@ -241,6 +296,14 @@ def run_swarm(args):
     finally:
         for server in servers:
             server.shutdown()
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
+                proc.wait(timeout=10)  # reap; no zombies
         for dht in dhts:
             dht.shutdown()
         reset_client_rpc()

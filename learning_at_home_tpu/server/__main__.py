@@ -35,6 +35,10 @@ def main() -> None:
     p.add_argument("--expert-prefix", default="expert")
     p.add_argument("--expert-offset", type=int, default=0,
                    help="first expert index (partition a grid across servers)")
+    p.add_argument("--expert-uids", default=None,
+                   help="comma-separated explicit uid list (e.g. "
+                        "'ffn0.1,ffn1.3'); overrides prefix/offset/num; "
+                        "params seeded stably per uid")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--dht-port", type=int, default=0)
@@ -87,12 +91,18 @@ def main() -> None:
         warmup = args.warmup if args.warmup else True
     else:
         warmup = False
+    expert_uids = None
+    if args.expert_uids is not None:
+        expert_uids = [u.strip() for u in args.expert_uids.split(",") if u.strip()]
+        if not expert_uids:
+            raise SystemExit("--expert-uids given but empty")
     server = Server.create(
         num_experts=args.num_experts,
         expert_cls=args.expert_cls,
         hidden_dim=args.hidden_dim,
         expert_prefix=args.expert_prefix,
         expert_offset=args.expert_offset,
+        expert_uids=expert_uids,
         optimizer=optimizer,
         max_batch_size=args.max_batch_size,
         warmup=warmup,

@@ -92,24 +92,37 @@ class Server:
         warmup=False,
         seed: int = 0,
         start: bool = True,
+        expert_uids=None,
         **server_kwargs,
     ) -> "Server":
         """Build a server from the expert zoo and (optionally) start it —
         the reference's ``Server.create`` convenience (SURVEY.md §3.3).
 
-        Expert UIDs are ``{prefix}.{offset+i}``; partition a grid across
-        machines with ``expert_offset``.  ``warmup`` AOT-precompiles batch
+        Expert UIDs are ``{prefix}.{offset+i}``, OR pass ``expert_uids``
+        (an explicit iterable) to host arbitrary uids — params then seed
+        stably per uid (crc32) so every process that ever hosts a uid
+        initializes identical weights.  ``warmup`` AOT-precompiles batch
         buckets before returning (recommended for serving): ``True`` = all
         power-of-two buckets, or a list of explicit bucket sizes."""
+        import zlib
+
         from learning_at_home_tpu.models import make_expert
 
         optimizer = optimizer if optimizer is not None else optax.adam(1e-3)
+        if expert_uids is not None:
+            uid_keys = [
+                (uid, jax.random.PRNGKey(zlib.crc32(uid.encode()) & 0x7FFFFFFF))
+                for uid in expert_uids
+            ]
+        else:
+            uid_keys = [
+                (f"{expert_prefix}.{i}", jax.random.PRNGKey(seed + i))
+                for i in range(expert_offset, expert_offset + num_experts)
+            ]
         experts = {}
-        for i in range(expert_offset, expert_offset + num_experts):
-            uid = f"{expert_prefix}.{i}"
+        for uid, key in uid_keys:
             apply_fn, params = make_expert(
-                expert_cls, hidden_dim, jax.random.PRNGKey(seed + i),
-                jnp.zeros((2, hidden_dim)),
+                expert_cls, hidden_dim, key, jnp.zeros((2, hidden_dim))
             )
             experts[uid] = ExpertBackend(
                 uid, apply_fn, params, optimizer, max_batch_size=max_batch_size
