@@ -76,3 +76,20 @@ def test_train_lm_pod_smoke():
         timeout=300,
     )
     assert lines and all("loss" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_train_lm_swarm_subprocess_smoke():
+    """The headline decentralized trainer, against REAL server processes."""
+    lines = run_script(
+        [
+            "experiments/train_lm.py", "--mode", "swarm",
+            "--subprocess-servers", "--steps", "3",
+            "--experts-per-layer", "2", "--n-servers", "1",
+            "--n-layers", "1", "--batch-size", "2", "--d-model", "16",
+            "--seq-len", "8", "--log-every", "2",
+            "--base-port", "45310",
+        ],
+        timeout=420,
+    )
+    assert lines and all("loss" in l for l in lines)
