@@ -1,29 +1,169 @@
 """North-star benchmark: DMoE-Transformer training tokens/sec/chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extra}.
-Runs the flagship sharded-MoE training step on whatever device is present
-(the driver runs it on the real TPU chip; falls back to CPU for local
-smoke).  ``vs_baseline`` is 1.0 by definition: the reference's published
-numbers are unrecoverable in this environment (BASELINE.md — empty
-``published`` table, unreadable mount), so this benchmark IS the baseline
-the next rounds must beat.
+
+Self-defending against a wedged TPU tunnel (the round-1 failure mode:
+``jax.devices()`` on the axon platform can either raise or hang forever
+depending on the relay's state).  Structure:
+
+- The parent process NEVER initializes a JAX backend.  It probes the
+  ambient platform in a disposable subprocess with an internal
+  ``faulthandler`` deadline, then runs the actual benchmark in a worker
+  subprocess — on the ambient (TPU) platform if the probe succeeded, else
+  on CPU with the scrubbed env from ``utils/subproc.py``.
+- Workers arm ``faulthandler.dump_traceback_later(..., exit=True)`` so a
+  hang becomes a stack dump + clean exit instead of an rc=124 timeout.
+- Whatever happens, the parent prints exactly one JSON line on stdout and
+  exits 0; diagnostics go to stderr.
+
+``vs_baseline`` is measured against the best prior-round number recorded
+in BASELINE.md (reference's published numbers are unrecoverable in this
+environment — empty mount, no egress; see SURVEY.md §0).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Prior-round bests to compute vs_baseline against (BASELINE.md).
+BASELINE_TPS = {"cpu": 190.0}  # round-1 CPU fallback, shrunk config
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
+TPU_PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+
+PROBE_SRC = (
+    "import faulthandler; faulthandler.dump_traceback_later({dl}, exit=True)\n"
+    "import jax\n"
+    "d = jax.devices()[0]\n"
+    "print('PROBE_PLATFORM=' + d.platform, flush=True)\n"
+)
 
 
-def main() -> None:
-    platform = jax.devices()[0].platform
-    on_tpu = platform not in ("cpu",)
+def _tail(s: str, n: int = 800) -> str:
+    return s[-n:] if s else ""
+
+
+def probe_platform(deadline: int = 75) -> str | None:
+    """Resolve the ambient JAX platform in a throwaway subprocess."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC.format(dl=deadline)],
+            capture_output=True,
+            text=True,
+            timeout=deadline + 20,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: platform probe timed out", file=sys.stderr)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PROBE_PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    print(f"bench: platform probe failed rc={r.returncode}: "
+          f"{_tail(r.stderr)}", file=sys.stderr)
+    return None
+
+
+def run_worker(env: dict, deadline: int, label: str) -> dict | None:
+    """Run ``bench.py --worker`` under ``env``; parse its last JSON line."""
+    env = dict(env)
+    env["BENCH_DEADLINE_S"] = str(deadline)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--worker"],
+            capture_output=True,
+            text=True,
+            timeout=deadline + 30,
+            cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        print(f"bench[{label}]: worker timed out after {deadline + 30}s\n"
+              f"{_tail(str(e.stdout))}\n{_tail(str(e.stderr))}", file=sys.stderr)
+        return None
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"bench[{label}]: worker rc={r.returncode}, no JSON line\n"
+          f"stdout: {_tail(r.stdout)}\nstderr: {_tail(r.stderr)}",
+          file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    ambient = os.environ.get("JAX_PLATFORMS", "")
+    result = None
+
+    if not force_cpu and ambient not in ("cpu",):
+        platform = probe_platform()
+        if platform and platform != "cpu":
+            print(f"bench: ambient platform '{platform}' is live; "
+                  "benchmarking on it", file=sys.stderr)
+            result = run_worker(dict(os.environ), deadline=420, label=platform)
+        else:
+            print("bench: no usable accelerator platform; falling back to CPU",
+                  file=sys.stderr)
+
+    if result is None:
+        from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+        env = clean_jax_subprocess_env(repo_root=REPO)
+        env.pop("XLA_FLAGS", None)  # no virtual multi-device for the bench
+        result = run_worker(env, deadline=300, label="cpu")
+
+    if result is None:  # even the CPU fallback failed: still emit the line
+        result = {
+            "metric": "DMoE-Transformer training throughput",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "platform": "none",
+            "error": "both TPU and CPU bench workers failed; see stderr",
+        }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# worker: the actual measurement, run in a subprocess by main()
+# --------------------------------------------------------------------------
+
+
+def _model_flops_per_step(cfg, batch: int) -> float:
+    """Analytic model FLOPs for one train step (fwd+bwd ≈ 3× fwd matmuls)."""
+    d, s, v, L = cfg.d_model, cfg.seq_len, cfg.vocab_size, cfg.n_layers
+    f = 4 * d  # ShardedMixtureOfExperts ffn_mult=4
+    per_token_fwd = (
+        2 * d * v  # logits projection (tied embedding)
+        + L * (8 * d * d + 4 * s * d + cfg.k * 4 * d * f)
+    )
+    return 3.0 * per_token_fwd * batch * s
+
+
+def worker() -> None:
+    import faulthandler
+
+    deadline = int(os.environ.get("BENCH_DEADLINE_S", "420"))
+    faulthandler.dump_traceback_later(deadline, exit=True)
 
     import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    print(f"bench worker: platform={platform}", file=sys.stderr)
 
     from __graft_entry__ import _flagship
     from learning_at_home_tpu.models.transformer import DMoETransformerLM
@@ -34,24 +174,46 @@ def main() -> None:
     if not on_tpu:  # local smoke only: shrink to something a 1-core CPU can turn
         cfg = dataclasses.replace(cfg, num_experts=8, dtype=jnp.float32)
         model = DMoETransformerLM(cfg, mesh)
-    batch = 32 if on_tpu else 4
+    if os.environ.get("BENCH_EXPERTS"):
+        cfg = dataclasses.replace(cfg, num_experts=int(os.environ["BENCH_EXPERTS"]))
+        model = DMoETransformerLM(cfg, mesh)
+
     params = model.init_params(jax.random.PRNGKey(0))
     optimizer = optax.adamw(1e-3)
     opt_state = model.init_opt_state(optimizer, params)
     step = model.make_train_step(optimizer)
-
-    rs = np.random.RandomState(0)
     sharding = batch_sharding(mesh)
-    ids = jax.device_put(
-        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))), sharding
-    )
-    tgt = jax.device_put(
-        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))), sharding
-    )
+    rs = np.random.RandomState(0)
 
-    # warmup / compile
-    params, opt_state, loss, _ = step(params, opt_state, ids, tgt)
-    jax.block_until_ready(loss)
+    # Pick the largest batch that fits: on OOM, halve and retry.
+    candidates = [int(os.environ["BENCH_BATCH"])] if os.environ.get(
+        "BENCH_BATCH") else ([128, 64, 32, 16] if on_tpu else [4])
+    batch = None
+    for cand in candidates:
+        ids = jax.device_put(
+            jnp.asarray(rs.randint(0, cfg.vocab_size, (cand, cfg.seq_len))),
+            sharding,
+        )
+        tgt = jax.device_put(
+            jnp.asarray(rs.randint(0, cfg.vocab_size, (cand, cfg.seq_len))),
+            sharding,
+        )
+        try:
+            p2, o2, loss, _ = step(params, opt_state, ids, tgt)
+            jax.block_until_ready(loss)
+            params, opt_state, batch = p2, o2, cand
+            break
+        except Exception as e:  # XLA OOM → try the next smaller batch
+            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+                print(f"bench worker: batch={cand} OOM, trying smaller",
+                      file=sys.stderr)
+                # the step donated params/opt_state; rebuild them fresh
+                params = model.init_params(jax.random.PRNGKey(0))
+                opt_state = model.init_opt_state(optimizer, params)
+                continue
+            raise
+    if batch is None:
+        raise RuntimeError("no batch size fit in device memory")
 
     n_steps = 20 if on_tpu else 5
     t0 = time.perf_counter()
@@ -62,20 +224,31 @@ def main() -> None:
 
     tokens_per_step = batch * cfg.seq_len
     tps = tokens_per_step * n_steps / elapsed
+    step_s = elapsed / n_steps
     result = {
         "metric": "DMoE-Transformer training throughput "
         f"({cfg.num_experts} experts, d_model={cfg.d_model}, "
         f"L={cfg.n_layers}, seq={cfg.seq_len}, batch={batch}, top-{cfg.k})",
         "value": round(tps, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(tps / BASELINE_TPS[platform], 3)
+        if platform in BASELINE_TPS else 1.0,
         "platform": platform,
-        "step_ms": round(1000 * elapsed / n_steps, 2),
+        "step_ms": round(1000 * step_s, 2),
         "final_loss": round(float(loss), 4),
         "dropped_fraction": round(float(metrics["dropped_fraction"]), 4),
     }
-    print(json.dumps(result))
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if on_tpu and gen in TPU_PEAK_BF16:
+        flops = _model_flops_per_step(cfg, batch)
+        result["mfu"] = round(flops / step_s / TPU_PEAK_BF16[gen], 4)
+        result["tpu_gen"] = gen
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker()
+        sys.exit(0)
     sys.exit(main())
