@@ -53,7 +53,11 @@ logger = logging.getLogger(__name__)
 
 
 class MoEDispatchError(RuntimeError):
-    """Quorum not reached: some sample got fewer than k_min expert replies."""
+    """Total dispatch failure: no expert replied for ANY sample (or no
+    experts are alive at all).  Per-sample quorum misses do NOT raise —
+    those samples are masked to zero contribution and counted in
+    ``samples_dropped`` (the swarm is staleness- and loss-tolerant by
+    design; one dead server must degrade the batch, not kill the step)."""
 
 
 class RemoteMixtureOfExperts:
@@ -119,6 +123,11 @@ class RemoteMixtureOfExperts:
         # dispatch latency telemetry (north-star: dispatch p50); bounded so
         # long runs don't grow memory
         self.dispatch_times: deque[float] = deque(maxlen=10_000)
+        # per-sample quorum telemetry: samples whose reply count fell below
+        # k_min (forward) / backward_k_min (backward) and were masked out
+        self.samples_total = 0
+        self.samples_dropped = 0
+        self.backward_samples_dropped = 0
 
     # ---- gate parameters ----
 
@@ -144,9 +153,13 @@ class RemoteMixtureOfExperts:
         for d in range(self.n_dims):
             flat_idx = idx[:, :, d] + self._grid_offsets[d]
             scores = scores + jnp.take_along_axis(logits_concat, flat_idx, axis=1)
-        scores = jnp.where(mask, scores, -jnp.inf)
+        # finite mask value (not -inf, and dtype-aware so fp16 doesn't
+        # overflow it to -inf): a fully-masked row — a sample whose quorum
+        # failed and was dropped — must yield zero weights, not NaN
+        big_neg = jnp.asarray(jnp.finfo(scores.dtype).min / 2, scores.dtype)
+        scores = jnp.where(mask, scores, big_neg)
         weights = jax.nn.softmax(scores, axis=-1)
-        weights = jnp.where(mask, weights, 0.0)  # all-False rows can't occur (k_min ≥ 1)
+        weights = jnp.where(mask, weights, 0.0)
         return jnp.einsum("bk,bkd->bd", weights.astype(y.dtype), y)
 
     # ---- custom-vjp dispatch crossing the network ----
@@ -232,10 +245,9 @@ class RemoteMixtureOfExperts:
             alive_uids = sorted(
                 filter_valid_uids(alive, self.uid_prefix, self.grid_size)
             )
-        if len(alive_uids) < self.k_min:
+        if not alive_uids:
             raise MoEDispatchError(
-                f"only {len(alive_uids)} alive experts under prefix "
-                f"{self.uid_prefix!r}, need k_min={self.k_min}"
+                f"no alive experts under prefix {self.uid_prefix!r}"
             )
         sel, coords = select_top_k(logits, alive_uids, self.k_best)  # [B, k']
         k_eff = sel.shape[1]
@@ -274,15 +286,38 @@ class RemoteMixtureOfExperts:
         for uid, (endpoint, x_rows, rows, slots, reply) in results.items():
             if reply is None:
                 continue
-            y[rows, slots] = np.asarray(reply[0], x.dtype)[: len(rows)]
+            arr = np.asarray(reply[0], x.dtype)
+            if arr.shape != (len(rows), x.shape[1]):
+                # wrong-arity reply from a buggy/malicious expert: treat it
+                # exactly like a failed RPC, never slice-and-accept
+                logger.warning(
+                    "expert %s returned shape %s, expected %s — discarding",
+                    uid, arr.shape, (len(rows), x.shape[1]),
+                )
+                continue
+            y[rows, slots] = arr
             mask[rows, slots] = True
             session[uid] = (endpoint, x_rows, rows, slots)
 
         per_sample = mask.sum(axis=1)
-        if (per_sample < self.k_min).any():
-            raise MoEDispatchError(
-                f"quorum failed: {(per_sample < self.k_min).sum()} of {batch} "
-                f"samples got fewer than k_min={self.k_min} expert replies"
+        dropped = per_sample < self.k_min
+        self.samples_total += batch
+        if dropped.any():
+            if dropped.all():
+                raise MoEDispatchError(
+                    f"total dispatch failure: no sample of {batch} reached "
+                    f"k_min={self.k_min} expert replies"
+                )
+            # per-sample degradation: below-quorum samples contribute zero
+            # (their mask rows go all-False → zero mixture weights) and are
+            # counted, but the step survives
+            n_drop = int(dropped.sum())
+            self.samples_dropped += n_drop
+            mask[dropped] = False
+            y[dropped] = 0.0
+            logger.warning(
+                "quorum miss: %d of %d samples below k_min=%d — masked to "
+                "zero contribution", n_drop, batch, self.k_min,
             )
 
         cid = -1
@@ -326,12 +361,31 @@ class RemoteMixtureOfExperts:
             if reply is None:
                 continue
             _, _, rows, slots = session[uid][:4]
-            gx[rows] += np.asarray(reply[0], gy.dtype)[: len(rows)]
+            arr = np.asarray(reply[0], gy.dtype)
+            if arr.shape != (len(rows), gy.shape[-1]):
+                logger.warning(
+                    "expert %s returned grad shape %s, expected %s — discarding",
+                    uid, arr.shape, (len(rows), gy.shape[-1]),
+                )
+                continue
+            gx[rows] += arr
             ok[rows] += 1
-        if (ok < self.backward_k_min).any():
-            raise MoEDispatchError(
-                f"backward quorum failed: {(ok < self.backward_k_min).sum()} "
-                f"samples got fewer than backward_k_min={self.backward_k_min} grads"
+        below = ok < self.backward_k_min
+        if below.any():
+            if below.all():
+                raise MoEDispatchError(
+                    f"total backward failure: no sample of {batch} reached "
+                    f"backward_k_min={self.backward_k_min} grad replies"
+                )
+            # mirror the forward degradation: below-quorum samples get zero
+            # input-gradient instead of killing the whole training step
+            n_drop = int(below.sum())
+            self.backward_samples_dropped += n_drop
+            gx[below] = 0.0
+            logger.warning(
+                "backward quorum miss: %d of %d samples below "
+                "backward_k_min=%d — zero input-grad", n_drop, batch,
+                self.backward_k_min,
             )
         return gx
 

@@ -105,6 +105,12 @@ class StaticExpertSource:
         return out
 
 
+def _top_union(scores: np.ndarray, width: int) -> np.ndarray:
+    """Union over the batch of each sample's top-``width`` column indices."""
+    width = min(width, scores.shape[1])
+    return np.unique(np.argpartition(-scores, width - 1, axis=1)[:, :width])
+
+
 async def beam_search_alive(
     source: "ExpertSource",
     uid_prefix: str,
@@ -114,27 +120,114 @@ async def beam_search_alive(
 ) -> dict[str, Endpoint]:
     """Find alive experts for a batch WITHOUT fetching the whole grid.
 
-    The reference walks DHT prefixes dimension-by-dimension per sample
-    (``first_k_active`` beam search).  Our record layout stores every alive
-    full uid under each prefix level, so one pruning step suffices: take
-    each sample's top ``beam_size`` first-dimension indices (union over the
-    batch), fetch those ``prefix.i`` records in parallel, and return the
-    union of alive experts found — a handful of small record fetches
-    instead of one giant top-level record for a 4096-expert grid.
+    True per-dimension prefix walk (the reference's ``first_k_active``
+    contract, SURVEY.md §3.1): starting from each sample's top
+    ``beam_size`` first-dimension indices, at every intermediate level ask
+    the DHT which candidate prefixes are active (one batched
+    ``first_k_active``), keep only active ones, extend them with the next
+    dimension's per-sample top indices, and prune the union to
+    ``4·beam_size`` by best-over-batch score.  Only the deepest prefix
+    level (leaf rows, which hold at most ``grid_size[-1]`` subkey records
+    each) fetches endpoint records.  Total DHT reads are therefore
+    O(beam · dims) — independent of grid volume, unlike enumerating a
+    4096-expert top-level record.
+
+    If an entire level's candidates turn out dead, that level is retried
+    ONCE with all extensions of the surviving parent beam (capped at the
+    same ``4·beam_size`` budget) — a dead row diverts the walk instead of
+    ending it, while the fetch bound stays O(beam · dims).  Beyond that
+    cap the search is best-effort, exactly like the reference's bounded
+    ``first_k_active`` scan.
 
     Returns uid → endpoint for the candidate set (callers re-score exactly).
     """
-    dim0 = logits_per_dim[0]  # [batch, grid_0]
-    width = min(beam_size, dim0.shape[1])
-    per_sample = np.argpartition(-dim0, width - 1, axis=1)[:, :width]
-    needed = np.unique(per_sample)
-    prefixes = [f"{uid_prefix}{UID_DELIMITER}{int(i)}" for i in needed]
-    records = await asyncio.gather(
-        *(source.get_alive_experts(p) for p in prefixes)
-    )
-    alive: dict[str, Endpoint] = {}
-    for rec in records:
-        alive.update(rec)
+    n_dims = len(grid_size)
+    width = beam_size
+    union_cap = max(4 * beam_size, 8)
+
+    def prefixes_of(coords_list: list[tuple[int, ...]]) -> list[str]:
+        return [make_uid(uid_prefix, c) for c in coords_list]
+
+    def prune(coords_list: list[tuple[int, ...]]) -> list[tuple[int, ...]]:
+        if len(coords_list) <= union_cap:
+            return coords_list
+        best = score_experts(
+            logits_per_dim, np.asarray(coords_list, dtype=np.int64)
+        ).max(axis=0)
+        keep = np.argsort(-best)[:union_cap]
+        return [coords_list[i] for i in keep]
+
+    def all_extensions(
+        parent_beam: list[tuple[int, ...]], dim: int
+    ) -> list[tuple[int, ...]]:
+        """Every child of the parent beam along ``dim`` (root → whole dim 0)."""
+        if not parent_beam:
+            return [(i,) for i in range(grid_size[0])]
+        return [p + (i,) for p in parent_beam for i in range(grid_size[dim])]
+
+    def extend_top(
+        beam: list[tuple[int, ...]], dim: int
+    ) -> list[tuple[int, ...]]:
+        """Union over the batch of per-sample top (prefix, next-index) pairs."""
+        prev = np.asarray(beam, dtype=np.int64)  # [A, dim]
+        base = score_experts(logits_per_dim, prev)  # [B, A]
+        ext = base[:, :, None] + logits_per_dim[dim][:, None, :]  # [B, A, g]
+        g = ext.shape[2]
+        flat_idx = _top_union(ext.reshape(ext.shape[0], -1), width)
+        return [tuple(prev[i // g]) + (int(i % g),) for i in flat_idx]
+
+    async def active_subset(cands):
+        prefixes = prefixes_of(cands)
+        active = await source.first_k_active(prefixes, beam_size)
+        return [c for c, p in zip(cands, prefixes) if active[p]]
+
+    # depth-1 candidates: union over batch of per-sample top dim-0 indices
+    cand = [(int(i),) for i in _top_union(logits_per_dim[0], width)]
+    parent_beam: list[tuple[int, ...]] = []  # beam one level above cand
+
+    # walk until cand are leaf-row prefixes (depth n_dims-1); every
+    # intermediate level is pruned by an activity check first
+    for depth in range(1, n_dims - 1):
+        cand = prune(cand)
+        alive_coords = await active_subset(cand)
+        if not alive_coords:
+            # the whole level looked dead: one capped retry over every
+            # extension of the parent beam not already checked
+            seen = set(cand)
+            retry = prune(
+                [c for c in all_extensions(parent_beam, depth - 1)
+                 if c not in seen]
+            )
+            if retry:
+                alive_coords = await active_subset(retry)
+        if not alive_coords:
+            return {}
+        parent_beam = alive_coords
+        cand = extend_top(alive_coords, depth)
+
+    # cand are now leaf-row prefixes (each record holds ≤ grid_size[-1]
+    # subkeys; for 1-D grids they are the full uids themselves —
+    # DHT.get_alive_experts handles both)
+    async def fetch(cands) -> dict[str, Endpoint]:
+        records = await asyncio.gather(
+            *(source.get_alive_experts(p) for p in prefixes_of(cands))
+        )
+        merged: dict[str, Endpoint] = {}
+        for rec in records:
+            merged.update(rec)
+        return merged
+
+    cand = prune(cand)
+    alive = await fetch(cand)
+    if not alive:
+        # same one-shot capped reroute at the leaf level
+        seen = set(cand)
+        retry = prune(
+            [c for c in all_extensions(parent_beam, n_dims - 2 if n_dims > 1 else 0)
+             if c not in seen]
+        )
+        if retry:
+            alive = await fetch(retry)
     valid = set(filter_valid_uids(alive, uid_prefix, grid_size))
     return {uid: ep for uid, ep in alive.items() if uid in valid}
 

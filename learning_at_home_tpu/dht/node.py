@@ -35,7 +35,7 @@ class DHTNode:
         bucket_size: int = 20,
         alpha: int = 3,
         rpc_timeout: float = 3.0,
-        max_records: Optional[int] = None,
+        max_records: Optional[int] = 65536,
     ):
         self.node_id = node_id if node_id is not None else DHTID.generate()
         self.alpha = alpha
@@ -207,11 +207,23 @@ class DHTNode:
         """Write many subkeys of ONE key with a single iterative lookup and
         one batched store RPC per neighbor (the heartbeat hot path: all
         experts under a shared prefix key go out in one call)."""
+        from learning_at_home_tpu.dht.protocol import MAX_STORE_ITEMS
+
         target = DHTID.from_key(key)
         nearest = await self.find_nearest_nodes(target)
         items = [(target.to_bytes(), sk, v, e) for sk, v, e in entries]
+        # serving nodes cap items per store RPC; chunk client-side so a
+        # >1024-expert declaration is never silently truncated
+        chunks = [
+            items[i : i + MAX_STORE_ITEMS]
+            for i in range(0, len(items), MAX_STORE_ITEMS)
+        ]
         results = await asyncio.gather(
-            *(self.protocol.call_store(ep, items) for _, ep in nearest)
+            *(
+                self.protocol.call_store(ep, chunk)
+                for _, ep in nearest
+                for chunk in chunks
+            )
         )
         ok = {sk: any(r is not None and r.get(sk, False) for r in results)
               for sk, _, _ in entries}

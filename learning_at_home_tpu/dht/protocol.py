@@ -40,23 +40,35 @@ from learning_at_home_tpu.utils.timed_storage import (
 logger = logging.getLogger(__name__)
 
 PLAIN_SUBKEY = ""
+MAX_STORE_ITEMS = 1024  # per store RPC; a 256-expert heartbeat uses ~257
+MAX_KEY_BYTES = 512  # uids/prefixes are short; reject absurd keys
 
 
 class DHTRecordStorage:
-    """Per-key dict of subkey → (value, expiration); outer TTL = max inner."""
+    """Per-key dict of subkey → (value, expiration); outer TTL = max inner.
 
-    def __init__(self, maxsize: Optional[int] = None):
+    Both tiers are bounded: the swarm is a trust boundary (same as the wire
+    layer's 1 GiB frame cap), so an unauthenticated peer pushing store RPCs
+    must hit eviction, not exhaust memory."""
+
+    def __init__(
+        self, maxsize: Optional[int] = 65536, max_subkeys: int = 65536
+    ):
         self._records: TimedStorage[bytes, TimedStorage] = TimedStorage(maxsize)
+        self.max_subkeys = max_subkeys
 
     def store(
         self, key: bytes, subkey: str, value: Any, expiration: DHTExpiration
     ) -> bool:
         entry = self._records.get(key)
-        inner = entry[0] if entry is not None else TimedStorage()
+        inner = entry[0] if entry is not None else TimedStorage(self.max_subkeys)
         ok = inner.store(subkey, value, expiration)
         if ok:
             outer_exp = max(e for _, _, e in inner.items())
             self._records.store(key, inner, outer_exp)
+            # the outer tier is bounded too: if storing this key evicted it
+            # straight away, the caller must NOT be told it was replicated
+            ok = self._records.get(key) is not None
         return ok
 
     def get(self, key: bytes) -> dict[str, tuple[Any, DHTExpiration]]:
@@ -132,11 +144,16 @@ class DHTProtocol:
         if msg_type == "ping":
             return {"node_id": self.node_id.to_bytes()}
         if msg_type == "store":
+            # peer-supplied batch: bound item count and key/subkey sizes so
+            # one malicious frame can't stuff unbounded state
             ok = {}
-            for key, subkey, value, expiration in meta["items"]:
-                ok[subkey] = self.storage.store(
-                    bytes(key), subkey, value, float(expiration)
-                )
+            for key, subkey, value, expiration in meta["items"][:MAX_STORE_ITEMS]:
+                key = bytes(key)
+                if len(key) > MAX_KEY_BYTES or not isinstance(subkey, str) \
+                        or len(subkey) > MAX_KEY_BYTES:
+                    ok[str(subkey)[:64]] = False
+                    continue
+                ok[subkey] = self.storage.store(key, subkey, value, float(expiration))
             return {"ok": ok}
         if msg_type == "find_node":
             return {"peers": self._nearest(meta["key"])}

@@ -91,6 +91,24 @@ class ConnectionHandler:
                         f"expert {uid} takes {backend.n_inputs} inputs, "
                         f"request declared {n_inputs}"
                     )
+                # mirror the forward guard: a backward request carries the
+                # inputs PLUS the grad_outputs; wrong arity in EITHER
+                # direction must be rejected before it can poison a formed
+                # batch (exact check once n_outputs is known, i.e. after
+                # warmup or the first forward)
+                expected = (
+                    backend.n_inputs + backend.n_outputs
+                    if backend.n_outputs is not None
+                    else None
+                )
+                if (expected is not None and len(tensors) != expected) or (
+                    len(tensors) <= backend.n_inputs
+                ):
+                    raise ValueError(
+                        f"backward for {uid} needs "
+                        f"{expected or f'>{backend.n_inputs}'} tensors "
+                        f"(inputs + grad_outputs), got {len(tensors)}"
+                    )
                 outputs = await self.server.backward_pools[uid].submit_task(*tensors)
                 return pack_message("result", outputs)
             elif msg_type == "info":

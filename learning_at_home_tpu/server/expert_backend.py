@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -81,6 +81,10 @@ class ExpertBackend:
         else:
             self._input_treedef = None
         self.n_inputs = n_inputs  # wire arity: tensors before grad_outputs
+        # output wire arity: fixed by apply_fn's tree structure but only
+        # discoverable by tracing — set at warmup / first forward, then used
+        # to reject over-arity backward requests exactly
+        self.n_outputs: Optional[int] = None
         self.params = jax.device_put(params)
         self.opt_state = (
             jax.device_put(opt_state)
@@ -126,7 +130,9 @@ class ExpertBackend:
     def forward(self, inputs: Sequence[np.ndarray]):
         """Run the expert on one padded batch; returns flat output arrays."""
         outputs = self._jit_forward(self.params, tuple(inputs))
-        return jax.tree_util.tree_leaves(outputs)
+        leaves = jax.tree_util.tree_leaves(outputs)
+        self.n_outputs = len(leaves)
+        return leaves
 
     def backward(
         self, inputs: Sequence[np.ndarray], grad_outputs: Sequence[np.ndarray]
@@ -178,6 +184,7 @@ class ExpertBackend:
             self._jit_forward.lower(self.params, padded).compile()
             out_aval = jax.eval_shape(self._forward_impl, self.params, padded)
             leaves = jax.tree_util.tree_leaves(out_aval)
+            self.n_outputs = len(leaves)
             grad_out = (
                 leaves[0] if len(leaves) == 1 else tuple(leaves)
             )

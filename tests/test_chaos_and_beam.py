@@ -33,6 +33,85 @@ def test_beam_search_alive_static_source():
     assert set(alive) == {f"g.{i}.{j}" for i in (0, 2) for j in range(4)}
 
 
+class _CountingSource:
+    """ExpertSource wrapper counting DHT record reads (one per prefix key)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.reads = 0
+
+    async def get_alive_experts(self, prefix):
+        self.reads += 1
+        return await self.inner.get_alive_experts(prefix)
+
+    async def first_k_active(self, prefixes, k):
+        self.reads += len(prefixes)
+        return await self.inner.first_k_active(prefixes, k)
+
+
+def test_beam_search_3d_walks_dimensions():
+    """Deep grids are walked dimension-by-dimension with per-level pruning:
+    record reads stay O(beam·dims) on a 16x16x16 grid (4096 experts),
+    and an expert whose prefix chain tops every level is always found."""
+    import asyncio
+
+    rs = np.random.RandomState(3)
+    grid = (16, 16, 16)
+    # 40 alive experts at random coords + one at the known argmax chain
+    experts = {
+        f"d3.{rs.randint(16)}.{rs.randint(16)}.{rs.randint(16)}": ("h", 1)
+        for _ in range(40)
+    }
+    experts["d3.5.9.13"] = ("h", 2)
+    source = _CountingSource(StaticExpertSource(experts))
+
+    batch, beam = 4, 4
+    logits = [rs.randn(batch, g).astype(np.float32) for g in grid]
+    logits[0][0, 5] = 10.0  # sample 0's chain tops every level
+    logits[1][0, 9] = 10.0
+    logits[2][0, 13] = 10.0
+    alive = asyncio.run(beam_search_alive(source, "d3", logits, grid, beam))
+
+    assert "d3.5.9.13" in alive and alive["d3.5.9.13"] == ("h", 2)
+    assert set(alive) <= set(experts)  # never invents uids
+    # per-level budget: union_cap = 4*beam candidates at each of the two
+    # walked levels (first_k_active at depth 1, row fetches at depth 2)
+    assert source.reads <= 2 * 4 * beam + batch * beam, source.reads
+    # far below enumerating the 256 depth-2 rows or 4096 uids
+    assert source.reads < 64
+
+
+def test_beam_search_2d_dead_top_rows_reroutes():
+    """2-D grids reroute too: dead leaf rows trigger the one-shot capped
+    retry over the remaining first-dimension rows."""
+    import asyncio
+
+    grid = (8, 4)
+    experts = {"r2.6.1": ("h", 9)}  # only row 6 has anything alive
+    source = _CountingSource(StaticExpertSource(experts))
+    logits = [np.zeros((2, g), np.float32) for g in grid]
+    logits[0][:, 0] = 10.0  # both samples prefer (dead) row 0
+    logits[0][:, 6] = -5.0
+    alive = asyncio.run(beam_search_alive(source, "r2", logits, grid, beam_size=2))
+    assert set(alive) == {"r2.6.1"}
+
+
+def test_beam_search_3d_dead_top_rows_reroutes():
+    """If every top-scoring first-dimension row is dead, the walk rescans
+    dimension 0 instead of returning empty (dead rows divert, not end)."""
+    import asyncio
+
+    grid = (8, 4, 4)
+    experts = {"r.6.1.2": ("h", 9)}  # only row 6 has anything alive
+    source = _CountingSource(StaticExpertSource(experts))
+    batch = 2
+    logits = [np.zeros((batch, g), np.float32) for g in grid]
+    logits[0][:, 0] = 10.0  # both samples prefer (dead) row 0
+    logits[0][:, 6] = -5.0  # alive row scores worst
+    alive = asyncio.run(beam_search_alive(source, "r", logits, grid, beam_size=2))
+    assert set(alive) == {"r.6.1.2"}
+
+
 def test_beam_routing_matches_enumeration_on_dht():
     """With all rows alive and beam covering them, beam == enumerate."""
     dht = DHT()

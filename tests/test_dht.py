@@ -238,3 +238,37 @@ def test_dht_facade_bridge_from_foreign_loop():
         assert result["e.0"] == ("1.2.3.4", 5)
     finally:
         dht.shutdown()
+
+
+def test_record_storage_bounded():
+    """Both storage tiers are capped: a flood of keys or subkeys evicts
+    instead of growing without bound (the swarm is a trust boundary)."""
+    st = DHTRecordStorage(maxsize=4, max_subkeys=3)
+    exp = get_dht_time() + 30
+    stored = [st.store(f"k{i}".encode(), PLAIN_SUBKEY, [i], exp) for i in range(10)]
+    assert all(stored[:4])  # in-bounds stores succeed and say so
+    assert len(st) <= 4
+    for i in range(10):
+        st.store(b"one", f"sk{i}", [i], exp)
+    assert len(st.get(b"one")) <= 3
+
+
+def test_store_rpc_rejects_absurd_keys():
+    """Oversized keys/subkeys in a store RPC are refused, not stored."""
+    node = asyncio.run(DHTNode.create(maintenance_period=None))
+    try:
+        meta = {
+            "from": DHTID.generate().to_bytes(),
+            "port": 1,
+            "items": [
+                [b"x" * 10_000, PLAIN_SUBKEY, [1], get_dht_time() + 30],
+                [b"fine", "s" * 10_000, [1], get_dht_time() + 30],
+                [b"fine", "ok", [1], get_dht_time() + 30],
+            ],
+        }
+        reply = node.protocol._serve("store", meta, "127.0.0.1")
+        assert reply["ok"]["ok"] is True
+        assert sum(bool(v) for v in reply["ok"].values()) == 1
+        assert len(node.storage.get(b"fine")) == 1
+    finally:
+        asyncio.run(node.shutdown())

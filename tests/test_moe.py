@@ -108,9 +108,11 @@ def test_moe_top2_selects_best(moe_server):
 
 def test_moe_grad_flows_and_updates_experts(moe_server):
     endpoint, srv, source = moe_server
+    # generous grace: the update_count assertion below needs EVERY backward
+    # RPC to land, so slow-box stragglers must not be cancelled mid-test
     moe = RemoteMixtureOfExperts(
         in_features=HID, grid_size=(4,), uid_prefix="ffn", source=source,
-        k_best=4, k_min=4, backward_k_min=1,
+        k_best=4, k_min=4, backward_k_min=1, timeout_after_k_min=15.0,
     )
     gate = moe.init_gate_params(jax.random.PRNGKey(5))
     x = jnp.asarray(np.random.RandomState(3).randn(4, HID).astype(np.float32))
@@ -179,6 +181,46 @@ def test_moe_fault_tolerance_dead_server():
         w = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
         expected = w[:, 0:1] * local["ffn.0"] + w[:, 1:2] * local["ffn.1"]
         np.testing.assert_allclose(out, expected, atol=1e-4, rtol=1e-4)
+    reset_client_rpc()
+
+
+def test_moe_per_sample_quorum_degradation():
+    """A sample whose only chosen expert is dead is masked to ZERO
+    contribution and counted — the step survives; gradients stay finite
+    (per-sample degradation, not step-killing; VERDICT r1 item 6)."""
+    with background_server(
+        num_experts=2, hidden_dim=HID, expert_prefix="ffn", seed=13
+    ) as (ep_alive, srv):
+        with background_server(
+            num_experts=1, hidden_dim=HID, expert_prefix="dead", seed=14
+        ) as (ep_dead, _):
+            pass  # exits immediately → dead endpoint
+        source = StaticExpertSource({"ffn.0": ep_alive, "ffn.1": ep_dead})
+        moe = RemoteMixtureOfExperts(
+            in_features=HID, grid_size=(2,), uid_prefix="ffn", source=source,
+            k_best=1, k_min=1, forward_timeout=1.5, backward_timeout=1.5,
+        )
+        # deterministic routing: sample 0 → expert 0 (alive),
+        # sample 1 → expert 1 (dead)
+        w0 = np.zeros((HID, 2), np.float32)
+        w0[0, 0] = w0[1, 1] = 10.0
+        gate = {"w0": jnp.asarray(w0)}
+        x = np.zeros((2, HID), np.float32)
+        x[0, 0] = x[1, 1] = 1.0
+
+        out = np.asarray(moe(jnp.asarray(x), gate))
+        local = _local_outputs(srv, x)
+        np.testing.assert_allclose(out[0], local["ffn.0"][0], atol=1e-4)
+        np.testing.assert_allclose(out[1], 0.0)  # dropped, not poisoned
+        assert moe.samples_total == 2 and moe.samples_dropped == 1
+
+        # gradients survive too: dead sample contributes zero input-grad
+        def loss(gate, x):
+            return moe(jnp.asarray(x), gate).sum()
+
+        g = jax.grad(loss)(gate, x)
+        assert np.isfinite(np.asarray(g["w0"])).all()
+        assert moe.backward_samples_dropped >= 1
     reset_client_rpc()
 
 

@@ -114,6 +114,26 @@ def test_wrong_forward_arity_rejected_cleanly(pytree_server):
         expert.forward_blocking([np.ones((2, HID), np.float32)])
 
 
+def test_wrong_backward_arity_rejected_cleanly(pytree_server):
+    """A backward request with no grad_output tensors (arity == n_inputs)
+    must be rejected at the handler, before it can poison a formed batch."""
+    from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+    from learning_at_home_tpu.utils.connection import RemoteCallError
+
+    srv = pytree_server
+
+    async def call():
+        return await pool_registry().get(srv.endpoint).rpc(
+            "backward",
+            [np.ones((2, 1), np.float32), np.ones((2, HID), np.float32)],
+            {"uid": "py.0", "n_inputs": 2},
+            timeout=5.0,
+        )
+
+    with pytest.raises(RemoteCallError, match="grad_outputs"):
+        client_loop().run(call())
+
+
 def test_pytree_expert_forward_and_grad(pytree_server):
     srv = pytree_server
     # leaves arrive in flattened (sorted-key) order: [scale, x]; the
