@@ -46,6 +46,14 @@ def parse_args():
                         "heavy runs — a trainer must not share an XLA "
                         "runtime with its servers)")
     p.add_argument("--base-port", type=int, default=45200, help="swarm mode")
+    p.add_argument("--pipeline", type=int, default=1,
+                   help="swarm mode: concurrent micro-batch steps in flight "
+                        "(PipelinedSwarmTrainer; 1 = sequential). Overlaps "
+                        "each step's RPC quorum waits with the next step's "
+                        "trunk compute — delayed parameter updates.")
+    p.add_argument("--chaos-latency", type=float, default=0.0,
+                   help="swarm + --subprocess-servers: inject WAN-like "
+                        "latency (s) on every server reply")
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--log-every", type=int, default=10)
@@ -141,10 +149,16 @@ def run_pod(args):
 
 
 def run_swarm(args):
+    import signal
+
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
+
+    # SIGTERM (e.g. `timeout`) must run the finally-block below, or the
+    # spawned server subprocesses outlive us and eat the host's cores
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 
     from learning_at_home_tpu.client import reset_client_rpc
     from learning_at_home_tpu.dht import DHT
@@ -193,7 +207,12 @@ def run_swarm(args):
                         "--update-period", "5.0",
                         "--optimizer", "adam", "--lr", str(args.lr),
                         "--max-batch-size", "4096",
-                    ],
+                    ]
+                    + (
+                        ["--chaos-latency", str(args.chaos_latency)]
+                        if args.chaos_latency
+                        else []
+                    ),
                     env=env,
                 )
             )
@@ -259,40 +278,69 @@ def run_swarm(args):
     tokens = load_corpus(args.data, seed=args.seed)
     batches = LMBatcher(tokens, args.batch_size, args.seq_len, seed=args.seed)
 
+    def dispatch_p50() -> float | None:
+        times = list(model.moes[0].dispatch_times)
+        return float(np.median(times) * 1000) if times else None
+
     try:
-        t0 = time.perf_counter()
-        for step, (ids, tgt) in zip(range(args.steps), batches):
-            params, opt_state, loss = step_fn(
-                params, opt_state, jnp.asarray(ids), jnp.asarray(tgt)
+        if args.pipeline > 1:
+            from learning_at_home_tpu.client import PipelinedSwarmTrainer
+
+            trainer = PipelinedSwarmTrainer(
+                model, optimizer, params, opt_state, n_workers=args.pipeline
             )
-            if step % args.log_every == 0 or step == args.steps - 1:
-                elapsed = time.perf_counter() - t0
-                tps = (step + 1) * args.batch_size * args.seq_len / elapsed
-                p50 = (
-                    float(np.median(list(model.moes[0].dispatch_times)) * 1000)
-                    if model.moes[0].dispatch_times
-                    else None
+
+            def on_log(entry):
+                p50 = dispatch_p50()
+                entry["dispatch_p50_ms"] = round(p50, 2) if p50 else None
+                print(json.dumps(entry), flush=True)
+
+            arrayified = (
+                (jnp.asarray(ids), jnp.asarray(tgt)) for ids, tgt in batches
+            )
+            summary = trainer.train(
+                arrayified, steps=args.steps, log_every=args.log_every,
+                on_log=on_log,
+                tokens_per_batch=args.batch_size * args.seq_len,
+            )
+            params, opt_state = trainer.params, trainer.opt_state
+            p50 = dispatch_p50()
+            print(json.dumps({
+                "pipeline": args.pipeline,
+                "tokens_per_sec": round(summary["tokens_per_sec"], 1),
+                "final_loss": round(summary["final_loss"], 4),
+                "dispatch_p50_ms": round(p50, 2) if p50 is not None else None,
+            }), flush=True)
+        else:
+            t0 = time.perf_counter()
+            for step, (ids, tgt) in zip(range(args.steps), batches):
+                params, opt_state, loss = step_fn(
+                    params, opt_state, jnp.asarray(ids), jnp.asarray(tgt)
                 )
-                print(
-                    json.dumps(
-                        {
-                            "step": step,
-                            "loss": round(float(loss), 4),
-                            "tokens_per_sec": round(tps, 1),
-                            "dispatch_p50_ms": round(p50, 2) if p50 else None,
-                            "server_updates": (
-                                sum(
-                                    b.update_count
-                                    for srv in servers
-                                    for b in srv.experts.values()
-                                )
-                                if servers
-                                else None  # remote processes: see info RPC
-                            ),
-                        }
-                    ),
-                    flush=True,
-                )
+                if step % args.log_every == 0 or step == args.steps - 1:
+                    elapsed = time.perf_counter() - t0
+                    tps = (step + 1) * args.batch_size * args.seq_len / elapsed
+                    p50 = dispatch_p50()
+                    print(
+                        json.dumps(
+                            {
+                                "step": step,
+                                "loss": round(float(loss), 4),
+                                "tokens_per_sec": round(tps, 1),
+                                "dispatch_p50_ms": round(p50, 2) if p50 else None,
+                                "server_updates": (
+                                    sum(
+                                        b.update_count
+                                        for srv in servers
+                                        for b in srv.experts.values()
+                                    )
+                                    if servers
+                                    else None  # remote processes: see info RPC
+                                ),
+                            }
+                        ),
+                        flush=True,
+                    )
     finally:
         for server in servers:
             server.shutdown()

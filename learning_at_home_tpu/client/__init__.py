@@ -4,5 +4,12 @@ from learning_at_home_tpu.client.rpc import (
     pool_registry,
     reset_client_rpc,
 )
+from learning_at_home_tpu.client.trainer import PipelinedSwarmTrainer
 
-__all__ = ["RemoteExpert", "client_loop", "pool_registry", "reset_client_rpc"]
+__all__ = [
+    "RemoteExpert",
+    "PipelinedSwarmTrainer",
+    "client_loop",
+    "pool_registry",
+    "reset_client_rpc",
+]

@@ -48,6 +48,9 @@ class RemoteExpert:
         timeout: float = 30.0,
         output_spec_fn: Optional[Callable] = None,
     ):
+        from learning_at_home_tpu.client.rpc import ensure_sync_cpu_dispatch
+
+        ensure_sync_cpu_dispatch()  # host-callback path: see rpc.py
         self.uid = uid
         self.endpoint = (endpoint[0], int(endpoint[1]))
         self.timeout = timeout

@@ -99,6 +99,9 @@ class RemoteMixtureOfExperts:
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
+        from learning_at_home_tpu.client.rpc import ensure_sync_cpu_dispatch
+
+        ensure_sync_cpu_dispatch()  # host-callback path: see rpc.py
         self.in_features = in_features
         self.grid_size = tuple(grid_size)
         self.n_dims = len(self.grid_size)
@@ -445,8 +448,21 @@ class RemoteMixtureOfExperts:
                     continue
                 results[uid] = (*jobs[uid], tensors)
                 per_sample[rows_of[uid]] += 1
-            if deadline is None and (per_sample >= quorum).all():
-                deadline = loop.time() + self.timeout_after_k_min
+            if deadline is None:
+                # arm the grace period once every sample is either quorate
+                # or HOPELESS (even if all its still-pending RPCs landed it
+                # could not reach quorum) — a crashed expert must not keep
+                # the whole gather waiting on other samples' stragglers.
+                # (A black-holed-but-pending RPC still counts as hope; the
+                # hard bound for those is rpc_timeout.)
+                still_possible = np.zeros(batch, np.int64)
+                for uid in pending.values():
+                    still_possible[rows_of[uid]] += 1
+                settled = (per_sample >= quorum) | (
+                    per_sample + still_possible < quorum
+                )
+                if settled.all():
+                    deadline = loop.time() + self.timeout_after_k_min
         for task in pending:
             task.cancel()
         return results

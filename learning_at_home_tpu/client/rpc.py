@@ -16,6 +16,39 @@ from learning_at_home_tpu.utils.connection import PoolRegistry
 _lock = threading.Lock()
 _loop: Optional[BackgroundLoop] = None
 _registry: Optional[PoolRegistry] = None
+_sync_dispatch_set = False
+
+
+def ensure_sync_cpu_dispatch() -> None:
+    """Disable XLA:CPU async dispatch — REQUIRED before any host-callback
+    dispatch path (RemoteExpert / RemoteMixtureOfExperts).
+
+    With async dispatch on, the CPU runtime can invoke an ``io_callback``
+    whose input buffers are still being produced by thunks queued on the
+    same (small) execution pool; the callback's ``np.asarray(arg)`` then
+    waits on a computation that needs the thread the callback occupies —
+    a deadlock.  Reproduced minimally on 1-core hosts at batch 2048
+    (2026-07-29); anything that blocks inside a callback (our RPC quorum
+    waits) is exposed.  Sync dispatch trades a little eager-mode pipelining
+    for correctness; the pod-mode jitted path is unaffected.
+    """
+    global _sync_dispatch_set
+    if _sync_dispatch_set:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        _sync_dispatch_set = True
+    except Exception as e:  # unknown option on this jax version
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "could not disable XLA:CPU async dispatch (%s: %s) — blocking "
+            "host callbacks may deadlock under load; see ensure_sync_cpu_"
+            "dispatch docstring", type(e).__name__, e,
+        )
+        _sync_dispatch_set = True
 
 
 def client_loop() -> BackgroundLoop:

@@ -1,0 +1,179 @@
+"""Pipelined swarm trainer: overlap RPC waits with local compute.
+
+The sequential swarm step serializes every MoE layer's forward fan-out,
+quorum wait, and backward fan-out — the host CPU idles during each network
+round-trip, which is why round-1 swarm throughput sat ~11× below pod mode
+on like hardware (BASELINE.md).  The reference's whole philosophy is
+asynchronous, staleness-tolerant training (server experts already apply
+delayed updates on every backward RPC), so the trainer can be asynchronous
+too: run several micro-batch steps concurrently and apply trunk/gate
+updates as each finishes — delayed parameter updates, the same contract as
+the server side.
+
+Mechanics: ``n_workers`` Python threads each run the EAGER train step of
+``SwarmDMoETransformerLM`` on their own micro-batch.  The two long poles —
+XLA trunk compute (releases the GIL) and the MoE dispatch's asyncio quorum
+wait (blocks on a future, releases the GIL) — interleave across workers,
+so while one step waits on expert replies another traces/computes.  A lock
+serializes only the optimizer apply; gradients are computed against the
+params snapshot taken at step start, i.e. updates may be ``n_workers - 1``
+steps stale (bounded staleness, same tolerance class as the server-side
+async SGD).
+
+Convergence note: this is hogwild-style on the trunk; use the same LR you
+would for small async staleness.  ``n_workers=1`` reproduces the exact
+sequential semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import optax
+
+__all__ = ["PipelinedSwarmTrainer"]
+
+
+class PipelinedSwarmTrainer:
+    """Runs concurrent micro-batch train steps against a swarm model.
+
+    Usage::
+
+        trainer = PipelinedSwarmTrainer(model, optimizer, params, n_workers=4)
+        result = trainer.train(batches, steps=100, on_log=print)
+        params = trainer.params
+    """
+
+    def __init__(
+        self,
+        model: Any,  # SwarmDMoETransformerLM-shaped: loss_fn(params, ids, tgt)
+        optimizer: optax.GradientTransformation,
+        params: Any,
+        opt_state: Any = None,
+        n_workers: int = 2,
+    ):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.model = model
+        self.optimizer = optimizer
+        self.params = params
+        self.opt_state = opt_state if opt_state is not None else optimizer.init(params)
+        self.n_workers = n_workers
+        self._apply_lock = threading.Lock()
+        self._batch_lock = threading.Lock()
+        self._grad_fn = jax.value_and_grad(model.loss_fn)
+        self.losses: list[float] = []
+        self.step_count = 0
+        self.errors: list[BaseException] = []
+
+    # ---- internals ----
+
+    def _next_batch(self, it: Iterator, budget: list[int]):
+        """Thread-safe batch claim; returns (step_idx, batch) or None."""
+        with self._batch_lock:
+            if budget[0] <= 0:
+                return None
+            budget[0] -= 1
+            try:
+                batch = next(it)
+            except StopIteration:
+                budget[0] = 0
+                return None
+            step_idx = self.step_count + 0  # informational only
+            return step_idx, batch
+
+    def _worker(self, it, budget, on_step: Optional[Callable]):
+        while True:
+            try:
+                claim = self._next_batch(it, budget)
+            except BaseException as e:  # iterator failure must not be silent
+                self.errors.append(e)
+                with self._batch_lock:
+                    budget[0] = 0
+                return
+            if claim is None:
+                return
+            _, (ids, tgt) = claim
+            params_snapshot = self.params  # delayed-update read
+            try:
+                loss, grads = self._grad_fn(params_snapshot, ids, tgt)
+            except BaseException as e:  # surface, don't strand the budget
+                self.errors.append(e)
+                with self._batch_lock:
+                    budget[0] = 0
+                return
+            with self._apply_lock:
+                updates, self.opt_state = self.optimizer.update(
+                    grads, self.opt_state, self.params
+                )
+                self.params = optax.apply_updates(self.params, updates)
+                self.step_count += 1
+                self.losses.append(float(loss))
+                step_now = self.step_count
+            if on_step is not None:
+                on_step(step_now, float(loss))
+
+    # ---- public API ----
+
+    def train(
+        self,
+        batches: Iterable,
+        steps: int,
+        log_every: int = 10,
+        on_log: Optional[Callable[[dict], None]] = None,
+        tokens_per_batch: Optional[int] = None,
+    ) -> dict:
+        """Consume ``steps`` micro-batches with ``n_workers`` concurrent
+        steps in flight; returns a summary dict (losses, tokens/sec)."""
+        it = iter(batches)
+        budget = [steps]
+        t0 = time.perf_counter()
+
+        def on_step(step_now: int, loss: float) -> None:
+            if on_log is not None and (
+                step_now % log_every == 0 or step_now == steps
+            ):
+                elapsed = time.perf_counter() - t0
+                entry = {
+                    "step": step_now,
+                    "loss": round(loss, 4),
+                    "steps_per_sec": round(step_now / elapsed, 2),
+                }
+                if tokens_per_batch:
+                    entry["tokens_per_sec"] = round(
+                        step_now * tokens_per_batch / elapsed, 1
+                    )
+                on_log(entry)
+
+        threads = [
+            threading.Thread(
+                target=self._worker, args=(it, budget, on_step),
+                name=f"swarm-trainer-{i}", daemon=True,
+            )
+            for i in range(self.n_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if self.errors:
+            raise self.errors[0]
+        elapsed = time.perf_counter() - t0
+        return {
+            "steps": self.step_count,
+            "elapsed_s": elapsed,
+            "final_loss": self.losses[-1] if self.losses else None,
+            "mean_loss_last_10": (
+                sum(self.losses[-10:]) / len(self.losses[-10:])
+                if self.losses
+                else None
+            ),
+            "tokens_per_sec": (
+                self.step_count * tokens_per_batch / elapsed
+                if tokens_per_batch
+                else None
+            ),
+        }

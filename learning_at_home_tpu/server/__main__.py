@@ -59,6 +59,11 @@ def main() -> None:
                         "before serving (e.g. --warmup 64 256 1024); "
                         "no value = all power-of-2 buckets")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chaos-latency", type=float, default=0.0,
+                   help="inject WAN-like base latency (seconds) per request")
+    p.add_argument("--chaos-jitter", type=float, default=0.0)
+    p.add_argument("--chaos-straggler-prob", type=float, default=0.0)
+    p.add_argument("--chaos-straggler-delay", type=float, default=1.5)
     args = p.parse_args()
 
     import logging
@@ -70,7 +75,7 @@ def main() -> None:
     import optax
 
     from learning_at_home_tpu.dht import DHT
-    from learning_at_home_tpu.server import Server
+    from learning_at_home_tpu.server import ChaosConfig, Server
 
     optimizer = {
         "adam": optax.adam,
@@ -112,6 +117,18 @@ def main() -> None:
         port=args.port,
         dht=dht,
         update_period=args.update_period,
+        chaos=(
+            ChaosConfig(
+                base_latency=args.chaos_latency,
+                jitter=args.chaos_jitter,
+                straggler_prob=args.chaos_straggler_prob,
+                straggler_delay=args.chaos_straggler_delay,
+                seed=args.seed,
+            )
+            if args.chaos_latency or args.chaos_jitter
+            or args.chaos_straggler_prob
+            else None
+        ),
     )
     experts = server.experts
     server.run_in_background()
