@@ -302,8 +302,8 @@ class RemoteMixtureOfExperts:
             mask[rows, slots] = True
             session[uid] = (endpoint, x_rows, rows, slots)
 
-        per_sample = mask.sum(axis=1)
-        dropped = per_sample < self.k_min
+        per_sample_ok = mask.sum(axis=1)
+        dropped = per_sample_ok < self.k_min
         self.samples_total += batch
         if dropped.any():
             if dropped.all():
@@ -327,7 +327,9 @@ class RemoteMixtureOfExperts:
         if store_session:
             cid = next(self._call_counter)
             with self._sessions_lock:
-                self._sessions[cid] = session
+                # the forward-dropped mask rides along so the backward path
+                # doesn't re-count those samples as backward failures
+                self._sessions[cid] = (session, dropped.copy())
                 while len(self._sessions) > self.max_sessions:
                     self._sessions.popitem(last=False)
         self.dispatch_times.append(_time.monotonic() - t0)
@@ -338,12 +340,13 @@ class RemoteMixtureOfExperts:
     def _host_backward(self, cid, gy):
         gy = np.asarray(gy)
         with self._sessions_lock:
-            session = self._sessions.pop(int(cid), None)
-        if session is None:
+            entry = self._sessions.pop(int(cid), None)
+        if entry is None:
             raise MoEDispatchError(
                 f"no dispatch session {int(cid)}: backward without forward, "
                 "or session evicted (raise max_sessions?)"
             )
+        session, fwd_dropped = entry
         batch = gy.shape[0]
         results = client_loop().run(
             self._quorum_fanout(
@@ -373,12 +376,15 @@ class RemoteMixtureOfExperts:
                 continue
             gx[rows] += arr
             ok[rows] += 1
-        below = ok < self.backward_k_min
+        # samples already dropped in forward contributed zero to the loss;
+        # their missing grads are expected, not a second failure
+        below = (ok < self.backward_k_min) & ~fwd_dropped
+        active = ~fwd_dropped
         if below.any():
-            if below.all():
+            if active.any() and below[active].all():
                 raise MoEDispatchError(
-                    f"total backward failure: no sample of {batch} reached "
-                    f"backward_k_min={self.backward_k_min} grad replies"
+                    f"total backward failure: no live sample of {batch} "
+                    f"reached backward_k_min={self.backward_k_min} grad replies"
                 )
             # mirror the forward degradation: below-quorum samples get zero
             # input-gradient instead of killing the whole training step
@@ -444,6 +450,19 @@ class RemoteMixtureOfExperts:
                         uid,
                         type(e).__name__,
                         e,
+                    )
+                    continue
+                # row-count check HERE, before the reply counts toward
+                # quorum: a fast wrong-shaped (buggy/malicious) reply must
+                # not arm the grace deadline and get honest stragglers
+                # cancelled (callers re-validate the full shape)
+                if not tensors or tensors[0].shape[0] != len(rows_of[uid]):
+                    logger.warning(
+                        "%s reply from %s has %s rows, expected %d — "
+                        "treating as failed",
+                        msg_type, uid,
+                        tensors[0].shape[0] if tensors else "no",
+                        len(rows_of[uid]),
                     )
                     continue
                 results[uid] = (*jobs[uid], tensors)

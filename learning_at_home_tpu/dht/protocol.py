@@ -148,11 +148,15 @@ class DHTProtocol:
             # one malicious frame can't stuff unbounded state
             ok = {}
             for key, subkey, value, expiration in meta["items"][:MAX_STORE_ITEMS]:
-                key = bytes(key)
-                if len(key) > MAX_KEY_BYTES or not isinstance(subkey, str) \
+                # type-check BEFORE bytes(): bytes(10**12) would try to
+                # allocate a terabyte of zeros from one malicious frame
+                if not isinstance(key, (bytes, bytearray, str)) \
+                        or not isinstance(subkey, str) \
+                        or len(key) > MAX_KEY_BYTES \
                         or len(subkey) > MAX_KEY_BYTES:
                     ok[str(subkey)[:64]] = False
                     continue
+                key = key.encode() if isinstance(key, str) else bytes(key)
                 ok[subkey] = self.storage.store(key, subkey, value, float(expiration))
             return {"ok": ok}
         if msg_type == "find_node":

@@ -220,7 +220,9 @@ def test_moe_per_sample_quorum_degradation():
 
         g = jax.grad(loss)(gate, x)
         assert np.isfinite(np.asarray(g["w0"])).all()
-        assert moe.backward_samples_dropped >= 1
+        # the forward-dropped sample's missing grads are EXPECTED — they
+        # must not be double-counted as a backward failure
+        assert moe.backward_samples_dropped == 0
     reset_client_rpc()
 
 
