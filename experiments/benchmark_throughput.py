@@ -35,6 +35,9 @@ def main():
     p.add_argument("--chaos-jitter", type=float, default=0.0)
     p.add_argument("--chaos-straggler-prob", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--transport", default="asyncio", choices=["asyncio", "native"],
+                   help="server data plane: asyncio loop or the C++ "
+                        "epoll framepump (native/framepump.cpp)")
     args = p.parse_args()
 
     import numpy as np
@@ -60,6 +63,7 @@ def main():
         max_batch_size=args.max_batch_size,
         chaos=chaos,
         seed=args.seed,
+        transport=args.transport,
     ) as (endpoint, srv):
         experts = [
             RemoteExpert(uid, endpoint, timeout=60.0) for uid in srv.experts
@@ -118,6 +122,7 @@ def main():
                 4,
             ),
             "device_time_s": round(srv.runtime.device_time, 2),
+            "transport": args.transport,
             "chaos": vars(chaos) if chaos else None,
         }
         print(json.dumps(result))

@@ -1,0 +1,147 @@
+"""ctypes bindings for the native (C++) server data plane.
+
+``FramePump`` wraps ``framepump.cpp`` — a GIL-free epoll thread that owns
+all socket work for the framed tensor RPC protocol (wire-compatible with
+``utils/serialization.py``).  The shared library is built on demand with
+the toolchain baked into the image (g++); the build is cached next to the
+source and rebuilt when the source is newer.
+
+Falls back cleanly: ``native_available()`` returns False when compilation
+fails (no compiler, non-Linux), and ``Server(transport="native")`` raises
+a clear error while the default asyncio transport keeps working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "framepump.cpp")
+_SO = os.path.join(_HERE, "_framepump.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native framepump build failed to run: %s", e)
+        return None
+    if r.returncode != 0:
+        logger.warning("native framepump build failed:\n%s", r.stderr[-2000:])
+        return None
+    return _SO
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.lah_pump_create.restype = ctypes.c_void_p
+        lib.lah_pump_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
+        ]
+        lib.lah_pump_next.restype = ctypes.c_int
+        lib.lah_pump_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.lah_pump_send.restype = ctypes.c_int
+        lib.lah_pump_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64
+        ]
+        lib.lah_pump_buffree.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.lah_pump_shutdown.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class FramePump:
+    """GIL-free epoll data plane; Python sees only whole frames."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native framepump unavailable (g++ build failed); "
+                "use transport='asyncio'"
+            )
+        self._lib = lib
+        # the C side binds with inet_addr (numeric only): resolve names here
+        import socket as _socket
+
+        try:
+            host = _socket.gethostbyname(host)
+        except OSError:
+            pass  # let bind() produce the error for truly bad hosts
+        out_port = ctypes.c_int(0)
+        self._h = lib.lah_pump_create(host.encode(), port, ctypes.byref(out_port))
+        if not self._h:
+            raise OSError(f"framepump could not bind {host}:{port}")
+        self.port = out_port.value
+        self._closed = False
+
+    def next(self, timeout: float = 0.2) -> Optional[tuple[int, bytes]]:
+        """Next complete inbound frame as (conn_id, payload).
+
+        None on timeout; raises ``EOFError`` after shutdown."""
+        conn = ctypes.c_uint64(0)
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        length = ctypes.c_uint64(0)
+        rc = self._lib.lah_pump_next(
+            self._h, int(timeout * 1000), ctypes.byref(conn),
+            ctypes.byref(buf), ctypes.byref(length),
+        )
+        if rc == 0:
+            return None
+        if rc < 0:
+            raise EOFError("framepump stopped")
+        try:
+            payload = ctypes.string_at(buf, length.value)
+        finally:
+            self._lib.lah_pump_buffree(buf)
+        return conn.value, payload
+
+    def send(self, conn_id: int, payload: bytes) -> bool:
+        """Queue a reply frame; False if the peer is gone (disconnected or
+        not reading replies — its queue cap was hit)."""
+        if self._closed:
+            return False
+        rc = self._lib.lah_pump_send(self._h, conn_id, payload, len(payload))
+        if rc == -2:
+            raise ValueError("frame exceeds MAX_FRAME_BYTES")
+        return rc == 0
+
+    def shutdown(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.lah_pump_shutdown(self._h)
+
+    def __del__(self):  # best-effort; explicit shutdown preferred
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -59,6 +59,10 @@ def main() -> None:
                         "before serving (e.g. --warmup 64 256 1024); "
                         "no value = all power-of-2 buckets")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--transport", default="asyncio",
+                   choices=["asyncio", "native"],
+                   help="data plane: asyncio loop, or the C++ epoll "
+                        "framepump (GIL-free socket work; multi-core hosts)")
     p.add_argument("--chaos-latency", type=float, default=0.0,
                    help="inject WAN-like base latency (seconds) per request")
     p.add_argument("--chaos-jitter", type=float, default=0.0)
@@ -117,6 +121,7 @@ def main() -> None:
         port=args.port,
         dht=dht,
         update_period=args.update_period,
+        transport=args.transport,
         chaos=(
             ChaosConfig(
                 base_latency=args.chaos_latency,

@@ -48,7 +48,18 @@ class Server:
         update_period: float = 15.0,
         batch_timeout: float = 0.002,
         chaos: Any = None,
+        transport: str = "asyncio",
+        native_workers: int = 2,
     ):
+        if transport not in ("asyncio", "native"):
+            raise ValueError(f"transport must be 'asyncio' or 'native', got {transport!r}")
+        self.transport = transport
+        self.native_workers = native_workers
+        self._pump = None
+        self._native_threads: list[threading.Thread] = []
+        self._native_stop = threading.Event()
+        self._native_chains: dict[int, Any] = {}
+        self._native_chains_lock = threading.Lock()
         self.experts = dict(experts)
         self.host, self._requested_port = host, port
         self.dht = dht
@@ -159,10 +170,28 @@ class Server:
 
     async def _start_async(self) -> None:
         handler = ConnectionHandler(self)
-        self._tcp_server = await asyncio.start_server(
-            handler.handle_connection, self.host, self._requested_port
-        )
-        self.port = self._tcp_server.sockets[0].getsockname()[1]
+        if self.transport == "native":
+            # GIL-free C++ epoll data plane (native/framepump.cpp): Python
+            # worker threads only see whole frames and bridge them onto the
+            # event loop for task-pool dispatch
+            from learning_at_home_tpu.native import FramePump
+
+            self._pump = FramePump(self.host, self._requested_port)
+            self.port = self._pump.port
+            for i in range(self.native_workers):
+                t = threading.Thread(
+                    target=self._native_worker,
+                    args=(handler,),
+                    name=f"lah-native-io-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._native_threads.append(t)
+        else:
+            self._tcp_server = await asyncio.start_server(
+                handler.handle_connection, self.host, self._requested_port
+            )
+            self.port = self._tcp_server.sockets[0].getsockname()[1]
         for pool in (*self.forward_pools.values(), *self.backward_pools.values()):
             pool.start(self.runtime)
         if self.dht is not None:
@@ -176,6 +205,79 @@ class Server:
             len(self.experts),
         )
         self._ready.set()
+
+    def _native_worker(self, handler: ConnectionHandler) -> None:
+        """Shovel whole frames from the native pump onto the event loop
+        (task pools are asyncio) WITHOUT waiting for each dispatch — the
+        reply is pushed back to the pump from a done-callback, so in-flight
+        concurrency matches the asyncio transport's one-coroutine-per-
+        request instead of being capped at the worker count.
+
+        Dispatches are CHAINED per connection: request N+1 on a connection
+        starts only after request N's reply was queued, making in-order
+        replies a server guarantee (the asyncio transport processes each
+        connection serially too) — not merely a property of this repo's
+        one-exchange-at-a-time client."""
+        pump = self._pump
+        chains = self._native_chains  # conn_id -> tail future (lock-guarded)
+
+        async def process(prev, payload: bytes):
+            if prev is not None:
+                try:
+                    await asyncio.wrap_future(prev)
+                except BaseException:
+                    pass  # prior request's failure was already logged
+            reply = await handler._dispatch(payload)
+            if self.chaos is not None and not await self.chaos.before_reply():
+                return None  # injected drop: client sees a timeout
+            return reply
+
+        def reply_cb(fut, conn_id):
+            try:
+                reply = fut.result()
+            except BaseException as e:  # incl. CancelledError at shutdown
+                if not isinstance(e, asyncio.CancelledError):
+                    logger.exception("native dispatch failed")
+                return
+            if reply is None:
+                return
+            try:
+                pump.send(conn_id, reply)  # cheap: C memcpy + eventfd
+            except ValueError:
+                logger.error("native reply exceeds frame cap — dropped")
+
+        n_since_cleanup = 0
+        while True:
+            if self._native_stop.is_set():
+                return
+            try:
+                item = pump.next(timeout=0.2)
+            except EOFError:
+                return
+            loop = self._loop  # snapshot: shutdown() nulls the attribute
+            if item is None or loop is None:
+                if loop is None:
+                    return
+                continue
+            conn_id, payload = item
+            try:
+                with self._native_chains_lock:
+                    prev = chains.get(conn_id)
+                    if prev is not None and prev.done():
+                        prev = None
+                    fut = asyncio.run_coroutine_threadsafe(
+                        process(prev, payload), loop.loop
+                    )
+                    chains[conn_id] = fut
+            except RuntimeError:  # loop closed mid-shutdown
+                return
+            fut.add_done_callback(lambda f, cid=conn_id: reply_cb(f, cid))
+            n_since_cleanup += 1
+            if n_since_cleanup >= 256:  # lazily drop finished chains
+                n_since_cleanup = 0
+                with self._native_chains_lock:
+                    for cid in [c for c, f in chains.items() if f.done()]:
+                        del chains[cid]
 
     async def _declare_experts_forever(self) -> None:
         """Liveness heartbeat: re-declare experts so DHT records stay fresh."""
@@ -236,9 +338,22 @@ class Server:
                 self._loop.loop.call_soon_threadsafe(pool.shutdown)
         if self._tcp_server is not None:
             self._loop.loop.call_soon_threadsafe(self._tcp_server.close)
+        # native teardown ORDER matters (the pump's shutdown frees its C
+        # state): stop workers, drain the loop (all reply callbacks fire on
+        # the loop thread before its join returns), join workers, and only
+        # then destroy the pump — nothing can touch freed memory after.
+        self._native_stop.set()
         self.runtime.shutdown()
-        self._loop.shutdown()
-        self._loop = None
+        loop = self._loop
+        self._loop = None  # signals native workers' timeout branch
+        loop.shutdown()
+        for t in self._native_threads:
+            t.join(timeout=5)
+        self._native_threads.clear()
+        if self._pump is not None:
+            with contextlib.suppress(Exception):
+                self._pump.shutdown()
+            self._pump = None
         logger.info("server shut down")
 
 
