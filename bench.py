@@ -148,6 +148,52 @@ def _model_flops_per_step(cfg, batch: int) -> float:
     return 3.0 * per_token_fwd * batch * s
 
 
+# HBM per chip by TPU generation (conservative usable figures).
+TPU_HBM_BYTES = {"v4": 32e9, "v5e": 16e9, "v5p": 95e9, "v6e": 32e9}
+
+
+def _tree_bytes(abstract) -> int:
+    import jax
+
+    return sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(abstract)
+    )
+
+
+def _static_state_bytes(model, optimizer) -> int:
+    """Exact params+opt-state+grads bytes via ``jax.eval_shape`` (no
+    device allocation, batch-independent)."""
+    import jax
+
+    abstract_params = jax.eval_shape(
+        model.init_params, jax.random.PRNGKey(0)
+    )
+    params_b = _tree_bytes(abstract_params)
+    opt_b = _tree_bytes(jax.eval_shape(optimizer.init, abstract_params))
+    return 2 * params_b + opt_b  # cotangents live alongside params
+
+
+def _activation_bytes(cfg, batch: int) -> int:
+    """Dominant activation terms for one train step (f32 logits fwd+bwd,
+    per-layer residual stream, MoE dispatch buffers)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    s, v, d, L, E = (
+        cfg.seq_len, cfg.vocab_size, cfg.d_model, cfg.n_layers,
+        cfg.num_experts,
+    )
+    tokens = batch * s
+    cap = int(np.ceil(cfg.capacity_factor * cfg.k * tokens / E))
+    act_dtype = jnp.dtype(cfg.dtype).itemsize
+    return (
+        tokens * v * 4 * 3  # f32 logits + grad-logits + softmax temps
+        + tokens * d * act_dtype * 10 * L  # residual stream + attn saves
+        + E * cap * d * act_dtype * 4 * L  # dispatch/return buffers
+        + tokens * E * 4 * 2  # router scores + top-k sort temps (f32)
+    )
+
+
 def worker() -> None:
     import faulthandler
 
@@ -171,49 +217,70 @@ def worker() -> None:
 
     mesh = make_mesh({"expert": 1}, devices=jax.devices()[:1])
     model, cfg = _flagship(mesh)  # ONE flagship definition, shared with the driver
-    if not on_tpu:  # local smoke only: shrink to something a 1-core CPU can turn
+    if on_tpu:
+        # Single-chip 256-expert shape ([BJ] config 3): 2.15 B expert
+        # params.  f32 params + AdamW need ~34 GB — impossible on one
+        # 16 GB v5e — so the single-chip bench stores params in bf16
+        # with a factored optimizer (Adafactor, no first moment); the
+        # pod deployment shards f32+AdamW state over the mesh instead.
+        cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16)
+        model = DMoETransformerLM(cfg, mesh)
+    else:  # local smoke only: shrink to something a 1-core CPU can turn
         cfg = dataclasses.replace(cfg, num_experts=8, dtype=jnp.float32)
         model = DMoETransformerLM(cfg, mesh)
     if os.environ.get("BENCH_EXPERTS"):
         cfg = dataclasses.replace(cfg, num_experts=int(os.environ["BENCH_EXPERTS"]))
         model = DMoETransformerLM(cfg, mesh)
 
+    opt_name = os.environ.get("BENCH_OPT", "adafactor" if on_tpu else "adamw")
+    if opt_name not in ("adafactor", "adamw"):
+        raise ValueError(f"BENCH_OPT must be adafactor|adamw, got {opt_name!r}")
+    optimizer = (
+        optax.adafactor(1e-3) if opt_name == "adafactor" else optax.adamw(1e-3)
+    )
+
+    # Analytic batch selection — NEVER probe batch sizes by catching OOM
+    # on the axon backend: a server-side OOM wedges the TPU tunnel for
+    # every subsequent process (observed 2026-07-29: bench batch=128
+    # OOM'd and backend init hung for all later processes).
+    hbm = TPU_HBM_BYTES.get(os.environ.get("PALLAS_AXON_TPU_GEN", ""), 16e9)
+    budget = 0.75 * hbm
+    static_b = _static_state_bytes(model, optimizer)
+    if os.environ.get("BENCH_BATCH"):
+        batch = int(os.environ["BENCH_BATCH"])
+    elif on_tpu:
+        batch = next(
+            (b for b in (64, 32, 16, 8, 4)
+             if static_b + _activation_bytes(cfg, b) <= budget),
+            None,
+        )
+        if batch is None:  # nothing fits: fail fast BEFORE touching HBM
+            print(f"bench worker: static state alone is {static_b / 1e9:.1f} "
+                  f"GB vs budget {budget / 1e9:.1f} GB; refusing to risk an "
+                  "OOM on the shared tunnel", file=sys.stderr)
+            sys.exit(1)
+    else:
+        batch = 4
+    est_gb = (static_b + _activation_bytes(cfg, batch)) / 1e9
+    print(f"bench worker: batch={batch} (estimated peak {est_gb:.1f} GB, "
+          f"budget {budget / 1e9:.1f} GB, opt={opt_name})", file=sys.stderr)
+
     params = model.init_params(jax.random.PRNGKey(0))
-    optimizer = optax.adamw(1e-3)
     opt_state = model.init_opt_state(optimizer, params)
     step = model.make_train_step(optimizer)
     sharding = batch_sharding(mesh)
     rs = np.random.RandomState(0)
 
-    # Pick the largest batch that fits: on OOM, halve and retry.
-    candidates = [int(os.environ["BENCH_BATCH"])] if os.environ.get(
-        "BENCH_BATCH") else ([128, 64, 32, 16] if on_tpu else [4])
-    batch = None
-    for cand in candidates:
-        ids = jax.device_put(
-            jnp.asarray(rs.randint(0, cfg.vocab_size, (cand, cfg.seq_len))),
-            sharding,
-        )
-        tgt = jax.device_put(
-            jnp.asarray(rs.randint(0, cfg.vocab_size, (cand, cfg.seq_len))),
-            sharding,
-        )
-        try:
-            p2, o2, loss, _ = step(params, opt_state, ids, tgt)
-            jax.block_until_ready(loss)
-            params, opt_state, batch = p2, o2, cand
-            break
-        except Exception as e:  # XLA OOM → try the next smaller batch
-            if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
-                print(f"bench worker: batch={cand} OOM, trying smaller",
-                      file=sys.stderr)
-                # the step donated params/opt_state; rebuild them fresh
-                params = model.init_params(jax.random.PRNGKey(0))
-                opt_state = model.init_opt_state(optimizer, params)
-                continue
-            raise
-    if batch is None:
-        raise RuntimeError("no batch size fit in device memory")
+    ids = jax.device_put(
+        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))),
+        sharding,
+    )
+    tgt = jax.device_put(
+        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))),
+        sharding,
+    )
+    params, opt_state, loss, _ = step(params, opt_state, ids, tgt)
+    jax.block_until_ready(loss)
 
     n_steps = 20 if on_tpu else 5
     t0 = time.perf_counter()
@@ -243,6 +310,13 @@ def worker() -> None:
         flops = _model_flops_per_step(cfg, batch)
         result["mfu"] = round(flops / step_s / TPU_PEAK_BF16[gen], 4)
         result["tpu_gen"] = gen
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            result["hbm_peak_gb"] = round(peak / 1e9, 2)
+    except Exception:
+        pass
     faulthandler.cancel_dump_traceback_later()
     print(json.dumps(result), flush=True)
 
