@@ -13,6 +13,7 @@ a clear error while the default asyncio transport keeps working.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import logging
 import os
@@ -31,17 +32,37 @@ _lib_lock = threading.Lock()
 
 
 def _build() -> Optional[str]:
+    """Compile the pump, safely under concurrent processes: an exclusive
+    flock serializes builders (a multi-server swarm starts N processes at
+    once) and the compiler writes to a temp path that is atomically
+    renamed into place, so no process can ever dlopen a half-written .so."""
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
+    import fcntl
+
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        with open(_SO + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            # another process may have finished the build while we waited
+            if os.path.exists(_SO) and (
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+            ):
+                return _SO
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+            if r.returncode != 0:
+                logger.warning(
+                    "native framepump build failed:\n%s", r.stderr[-2000:]
+                )
+                return None
+            os.replace(tmp, _SO)
     except (OSError, subprocess.TimeoutExpired) as e:
         logger.warning("native framepump build failed to run: %s", e)
         return None
-    if r.returncode != 0:
-        logger.warning("native framepump build failed:\n%s", r.stderr[-2000:])
-        return None
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
     return _SO
 
 
@@ -103,6 +124,11 @@ class FramePump:
             raise OSError(f"framepump could not bind {host}:{port}")
         self.port = out_port.value
         self._closed = False
+        # serializes send vs shutdown: a reply arriving on another thread
+        # during shutdown must either be queued on live C state or see
+        # _closed — never call into freed memory.  next() is NOT guarded
+        # (it blocks); callers must stop calling next() before shutdown().
+        self._call_lock = threading.Lock()
 
     def next(self, timeout: float = 0.2) -> Optional[tuple[int, bytes]]:
         """Next complete inbound frame as (conn_id, payload).
@@ -128,17 +154,22 @@ class FramePump:
     def send(self, conn_id: int, payload: bytes) -> bool:
         """Queue a reply frame; False if the peer is gone (disconnected or
         not reading replies — its queue cap was hit)."""
-        if self._closed:
-            return False
-        rc = self._lib.lah_pump_send(self._h, conn_id, payload, len(payload))
+        with self._call_lock:
+            if self._closed:
+                return False
+            rc = self._lib.lah_pump_send(
+                self._h, conn_id, payload, len(payload)
+            )
         if rc == -2:
             raise ValueError("frame exceeds MAX_FRAME_BYTES")
         return rc == 0
 
     def shutdown(self) -> None:
-        if not self._closed:
+        with self._call_lock:
+            if self._closed:
+                return
             self._closed = True
-            self._lib.lah_pump_shutdown(self._h)
+        self._lib.lah_pump_shutdown(self._h)
 
     def __del__(self):  # best-effort; explicit shutdown preferred
         try:

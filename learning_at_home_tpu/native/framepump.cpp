@@ -36,6 +36,7 @@
 #include <cstdio>
 #include <deque>
 #include <mutex>
+#include <new>
 #include <thread>
 #include <unordered_map>
 #include <unordered_set>
@@ -68,10 +69,13 @@ struct OutBuf {
 struct Conn {
   int fd = -1;
   uint64_t id = 0;
-  // read state machine: 4-byte LE length prefix, then body
+  // read state machine: 4-byte LE length prefix, then body.  The body is
+  // a malloc'd buffer recv'd into directly and handed to the inbox whole
+  // (ownership transfers; freed by lah_pump_buffree) — no intermediate
+  // copies on the hot path.
   uint8_t lenbuf[4];
   size_t lenoff = 0;
-  std::vector<uint8_t> body;
+  uint8_t* body = nullptr;
   uint64_t need = 0;
   uint64_t got = 0;
   bool reading_body = false;
@@ -79,6 +83,8 @@ struct Conn {
   std::deque<OutBuf> out;
   uint64_t out_bytes = 0;
   bool want_write = false;
+
+  ~Conn() { free(body); }
 };
 
 struct Pump {
@@ -165,7 +171,6 @@ bool flush_out(Pump* p, Conn* c) {
 
 // Read everything available; push complete frames into the inbox.
 bool pump_read(Pump* p, Conn* c) {
-  char tmp[65536];
   while (true) {
     ssize_t n;
     if (!c->reading_body) {
@@ -178,29 +183,30 @@ bool pump_read(Pump* p, Conn* c) {
       memcpy(&len, c->lenbuf, 4);  // wire is little-endian; so are we (x86/arm64)
       c->lenoff = 0;
       if (len > kMaxFrame) return false;  // oversized: drop the peer
+      // Allocation failure must drop the peer, never kill the process
+      // (the asyncio transport's equivalent is a per-connection error).
+      uint8_t* body = static_cast<uint8_t*>(malloc(len ? len : 1));
+      if (body == nullptr) return false;
+      c->body = body;
       c->need = len;
       c->got = 0;
-      c->body.resize(len);
       c->reading_body = true;
       if (len != 0) continue;
       // zero-length frame: deliver immediately
     } else {
-      n = recv(c->fd, tmp, sizeof(tmp) < (c->need - c->got)
-                               ? sizeof(tmp)
-                               : static_cast<size_t>(c->need - c->got), 0);
+      // recv straight into the frame buffer: zero intermediate copies
+      n = recv(c->fd, c->body + c->got,
+               static_cast<size_t>(c->need - c->got), 0);
       if (n == 0) return false;
       if (n < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
-      memcpy(c->body.data() + c->got, tmp, static_cast<size_t>(n));
       c->got += static_cast<uint64_t>(n);
       if (c->got < c->need) continue;
     }
-    // complete frame
-    uint8_t* data = static_cast<uint8_t*>(malloc(c->need ? c->need : 1));
-    if (c->need) memcpy(data, c->body.data(), c->need);
+    // complete frame: ownership of c->body moves to the inbox
     bool hit_high_water;
     {
       std::lock_guard<std::mutex> lk(p->mu);
-      p->inbox.push_back(Frame{c->id, data, c->need});
+      p->inbox.push_back(Frame{c->id, c->body, c->need});
       p->inbox_bytes += c->need;
       hit_high_water = !p->paused &&
                        (p->inbox.size() >= kInboxHighFrames ||
@@ -208,6 +214,7 @@ bool pump_read(Pump* p, Conn* c) {
       if (hit_high_water) p->paused = true;
     }
     p->cv.notify_one();
+    c->body = nullptr;
     c->reading_body = false;
     c->need = c->got = 0;
     if (hit_high_water) {
@@ -293,18 +300,22 @@ void pump_loop(Pump* p) {
       if (!ok) close_conn(p, c);
     }
   }
-  // teardown: close all fds, free queued frames, wake any waiters
+  // teardown ORDER: unpublish every Conn from by_id UNDER mu first, so a
+  // concurrent lah_pump_send can never find a Conn* we are about to free
+  // (it either mutated the conn while we waited for mu — harmless — or
+  // finds nothing); only then is it safe to delete.
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->by_id.clear();
+    p->dirty.clear();
+    for (Frame& f : p->inbox) free(f.data);
+    p->inbox.clear();
+  }
   for (auto& [fd, c] : p->by_fd) {
     close(fd);
     delete c;
   }
   p->by_fd.clear();
-  {
-    std::lock_guard<std::mutex> lk(p->mu);
-    p->by_id.clear();
-    for (Frame& f : p->inbox) free(f.data);
-    p->inbox.clear();
-  }
   p->cv.notify_all();
 }
 
@@ -384,14 +395,19 @@ int lah_pump_send(void* h, uint64_t conn, const uint8_t* buf, uint64_t len) {
     Conn* c = it->second;
     if (c->out_bytes + 4 + len > kConnOutMaxBytes)
       return -3;  // peer not reading replies; caller should treat as gone
-    OutBuf ob;
-    ob.data.resize(4 + len);
-    uint32_t l32 = static_cast<uint32_t>(len);
-    memcpy(ob.data.data(), &l32, 4);
-    if (len) memcpy(ob.data.data() + 4, buf, len);
-    c->out_bytes += ob.data.size();
-    c->out.push_back(std::move(ob));
-    p->dirty.insert(conn);
+    try {
+      OutBuf ob;
+      ob.data.resize(4 + len);
+      uint32_t l32 = static_cast<uint32_t>(len);
+      memcpy(ob.data.data(), &l32, 4);
+      if (len) memcpy(ob.data.data() + 4, buf, len);
+      c->out_bytes += ob.data.size();
+      c->out.push_back(std::move(ob));
+      p->dirty.insert(conn);
+    } catch (const std::bad_alloc&) {
+      return -3;  // OOM queueing the reply: treat the peer as gone;
+                  // never let a C++ exception cross the ctypes boundary
+    }
   }
   uint64_t one = 1;
   ssize_t ignored = write(p->evfd, &one, 8);

@@ -49,17 +49,20 @@ class Server:
         batch_timeout: float = 0.002,
         chaos: Any = None,
         transport: str = "asyncio",
-        native_workers: int = 2,
     ):
         if transport not in ("asyncio", "native"):
             raise ValueError(f"transport must be 'asyncio' or 'native', got {transport!r}")
         self.transport = transport
-        self.native_workers = native_workers
         self._pump = None
         self._native_threads: list[threading.Thread] = []
         self._native_stop = threading.Event()
+        # conn_id -> tail future; single-dispatcher-thread state (the one
+        # native worker is the only reader/writer, so no lock is needed —
+        # and a SINGLE popper is what makes per-connection reply order a
+        # guarantee: pop, chain-link, and callback-attach happen in
+        # program order on one thread, while all actual dispatch runs on
+        # the asyncio loop, so extra poppers add no concurrency anyway)
         self._native_chains: dict[int, Any] = {}
-        self._native_chains_lock = threading.Lock()
         self.experts = dict(experts)
         self.host, self._requested_port = host, port
         self.dht = dht
@@ -178,15 +181,14 @@ class Server:
 
             self._pump = FramePump(self.host, self._requested_port)
             self.port = self._pump.port
-            for i in range(self.native_workers):
-                t = threading.Thread(
-                    target=self._native_worker,
-                    args=(handler,),
-                    name=f"lah-native-io-{i}",
-                    daemon=True,
-                )
-                t.start()
-                self._native_threads.append(t)
+            t = threading.Thread(
+                target=self._native_worker,
+                args=(handler,),
+                name="lah-native-io",
+                daemon=True,
+            )
+            t.start()
+            self._native_threads.append(t)
         else:
             self._tcp_server = await asyncio.start_server(
                 handler.handle_connection, self.host, self._requested_port
@@ -207,19 +209,22 @@ class Server:
         self._ready.set()
 
     def _native_worker(self, handler: ConnectionHandler) -> None:
-        """Shovel whole frames from the native pump onto the event loop
-        (task pools are asyncio) WITHOUT waiting for each dispatch — the
-        reply is pushed back to the pump from a done-callback, so in-flight
-        concurrency matches the asyncio transport's one-coroutine-per-
-        request instead of being capped at the worker count.
+        """THE single dispatcher thread: shovels whole frames from the
+        native pump onto the event loop (task pools are asyncio) WITHOUT
+        waiting for each dispatch — the reply is pushed back to the pump
+        from a done-callback, so in-flight concurrency matches the asyncio
+        transport's one-coroutine-per-request.
 
         Dispatches are CHAINED per connection: request N+1 on a connection
         starts only after request N's reply was queued, making in-order
         replies a server guarantee (the asyncio transport processes each
         connection serially too) — not merely a property of this repo's
-        one-exchange-at-a-time client."""
+        one-exchange-at-a-time client.  Being the only popper is what
+        makes the chain sound: pop, link, and callback-attach happen in
+        program order here, with no lock and no second thread to invert
+        frames."""
         pump = self._pump
-        chains = self._native_chains  # conn_id -> tail future (lock-guarded)
+        chains = self._native_chains  # conn_id -> tail future (this thread only)
 
         async def process(prev, payload: bytes):
             if prev is not None:
@@ -260,24 +265,28 @@ class Server:
                     return
                 continue
             conn_id, payload = item
+            prev = chains.get(conn_id)
+            if prev is not None and prev.done():
+                prev = None
             try:
-                with self._native_chains_lock:
-                    prev = chains.get(conn_id)
-                    if prev is not None and prev.done():
-                        prev = None
-                    fut = asyncio.run_coroutine_threadsafe(
-                        process(prev, payload), loop.loop
-                    )
-                    chains[conn_id] = fut
+                fut = asyncio.run_coroutine_threadsafe(
+                    process(prev, payload), loop.loop
+                )
             except RuntimeError:  # loop closed mid-shutdown
                 return
+            chains[conn_id] = fut
+            # callback attached HERE, still in the dispatcher: attaching
+            # after releasing ordering control would let reply N land
+            # after N+1 when the dispatcher is preempted between link and
+            # attach (the chain only orders dispatch starts, and reply_cb
+            # for an already-done future runs inline on whichever thread
+            # attaches it)
             fut.add_done_callback(lambda f, cid=conn_id: reply_cb(f, cid))
             n_since_cleanup += 1
             if n_since_cleanup >= 256:  # lazily drop finished chains
                 n_since_cleanup = 0
-                with self._native_chains_lock:
-                    for cid in [c for c, f in chains.items() if f.done()]:
-                        del chains[cid]
+                for cid in [c for c, f in chains.items() if f.done()]:
+                    del chains[cid]
 
     async def _declare_experts_forever(self) -> None:
         """Liveness heartbeat: re-declare experts so DHT records stay fresh."""
@@ -349,10 +358,20 @@ class Server:
         loop.shutdown()
         for t in self._native_threads:
             t.join(timeout=5)
+        wedged = [t for t in self._native_threads if t.is_alive()]
         self._native_threads.clear()
         if self._pump is not None:
-            with contextlib.suppress(Exception):
-                self._pump.shutdown()
+            if wedged:
+                # A live worker may still be inside pump.next(); destroying
+                # the C state under it is a use-after-free.  Leaking one
+                # pump beats corrupting the process.
+                logger.error(
+                    "%d native worker(s) did not join; leaking the pump "
+                    "instead of freeing C state under them", len(wedged)
+                )
+            else:
+                with contextlib.suppress(Exception):
+                    self._pump.shutdown()
             self._pump = None
         logger.info("server shut down")
 
