@@ -33,12 +33,15 @@ logger = logging.getLogger(__name__)
 class RemoteExpert:
     """Stub for one expert hosted on a remote Server.
 
-    ``output_spec_fn(*input_specs) -> spec`` maps input ShapeDtypeStructs to
-    the output spec (io_callback needs static result shapes); the default —
-    output shaped like the first input — covers the standard expert blocks.
-    For pytree inputs the specs arrive in flattened (sorted-dict-key)
-    order, so pass an explicit ``output_spec_fn`` when the first leaf is
-    not output-shaped.
+    Output specs (io_callback needs static result shapes) resolve in
+    priority order:
+
+    1. an explicit ``output_spec_fn(*input_specs) -> spec-or-tuple``;
+    2. the server's published ``output_schema`` (per-row leaf shapes +
+       dtypes, set once the expert has warmed up or served a forward) —
+       fetched lazily with one ``info`` RPC and cached, this also enables
+       **multi-output experts** with no client-side configuration;
+    3. fallback: output shaped like the first input (the standard blocks).
     """
 
     def __init__(
@@ -54,7 +57,8 @@ class RemoteExpert:
         self.uid = uid
         self.endpoint = (endpoint[0], int(endpoint[1]))
         self.timeout = timeout
-        self.output_spec_fn = output_spec_fn or (lambda *specs: specs[0])
+        self.output_spec_fn = output_spec_fn
+        self._server_output_schema = ()  # () = not fetched yet; None = absent
         self._structure_checked = False
         self._call = self._build_custom_vjp()
 
@@ -88,42 +92,91 @@ class RemoteExpert:
 
     # ---- the jax-transformable call path ----
 
-    def _build_custom_vjp(self):
-        def host_forward(*inputs):
-            out = self.forward_blocking([np.asarray(x) for x in inputs])[0]
-            return out
+    def _output_specs(self, input_specs: tuple) -> tuple:
+        """Static output specs for io_callback (see class docstring for
+        the resolution order).  Always returns a tuple of specs."""
+        if self.output_spec_fn is not None:
+            spec = self.output_spec_fn(*input_specs)
+            return tuple(spec) if isinstance(spec, (tuple, list)) else (spec,)
+        if self._server_output_schema == ():
+            # cache ONLY a published schema; on RPC failure or a not-yet-
+            # warmed server (no schema in info) fall back for THIS trace
+            # and re-fetch on the next one — the schema appears as soon as
+            # the expert serves its first forward
+            try:
+                schema = self.info().get("output_schema")
+            except Exception:
+                logger.warning(
+                    "info RPC for %s failed; falling back to "
+                    "first-input-shaped output spec", self.uid, exc_info=True
+                )
+                schema = None
+            if schema:
+                self._server_output_schema = schema
+        else:
+            schema = self._server_output_schema
+        if schema:
+            rows = input_specs[0].shape[0]
+            return tuple(
+                jax.ShapeDtypeStruct(
+                    (rows, *s["shape"]), np.dtype(s["dtype"])
+                )
+                for s in schema
+            )
+        return (input_specs[0],)
 
-        def host_backward(*args):
-            *inputs, grad_out = [np.asarray(a) for a in args]
-            grads = self.backward_blocking(inputs, [grad_out])
-            return tuple(grads)
+    def _build_custom_vjp(self):
+        def host_backward(n_in, args):
+            arrs = [np.asarray(a) for a in args]
+            grads = self.backward_blocking(arrs[:n_in], arrs[n_in:])
+            if len(grads) != n_in:
+                raise ValueError(
+                    f"expert {self.uid} returned {len(grads)} input-grads "
+                    f"for {n_in} inputs"
+                )
+            return grads
 
         @jax.custom_vjp
         def remote_call(*inputs):
-            out_spec = self.output_spec_fn(
-                *(jax.ShapeDtypeStruct(np.shape(x), x.dtype) for x in inputs)
+            specs = self._output_specs(
+                tuple(jax.ShapeDtypeStruct(np.shape(x), x.dtype) for x in inputs)
             )
-            return io_callback(
-                lambda *xs: np.asarray(host_forward(*xs), dtype=out_spec.dtype),
-                out_spec,
-                *inputs,
-            )
+
+            def cb(*xs):
+                outs = self.forward_blocking([np.asarray(x) for x in xs])
+                if len(outs) != len(specs):
+                    raise ValueError(
+                        f"expert {self.uid} returned {len(outs)} outputs, "
+                        f"client expected {len(specs)}"
+                    )
+                return tuple(
+                    np.asarray(o, dtype=s.dtype) for o, s in zip(outs, specs)
+                )
+
+            out = io_callback(cb, specs, *inputs)
+            return out[0] if len(specs) == 1 else tuple(out)
 
         def fwd(*inputs):
             return remote_call(*inputs), inputs
 
         def bwd(residual_inputs, grad_out):
+            grads_out = (
+                list(grad_out)
+                if isinstance(grad_out, (tuple, list))
+                else [grad_out]
+            )
             in_specs = tuple(
                 jax.ShapeDtypeStruct(np.shape(x), x.dtype) for x in residual_inputs
             )
+            n_in = len(residual_inputs)
             return io_callback(
                 lambda *args: tuple(
                     np.asarray(g, dtype=s.dtype)
-                    for g, s in zip(host_backward(*args), in_specs)
+                    for g, s in zip(host_backward(n_in, args), in_specs)
                 ),
                 in_specs,
                 *residual_inputs,
-                grad_out,
+                *grads_out,
             )
 
         remote_call.defvjp(fwd, bwd)

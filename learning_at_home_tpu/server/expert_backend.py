@@ -85,6 +85,10 @@ class ExpertBackend:
         # discoverable by tracing — set at warmup / first forward, then used
         # to reject over-arity backward requests exactly
         self.n_outputs: Optional[int] = None
+        # per-leaf output schema (row dim stripped): published in the info
+        # RPC so clients can build io_callback result specs without a
+        # hand-written ``output_spec_fn``
+        self.output_schema: Optional[list] = None
         self.params = jax.device_put(params)
         self.opt_state = (
             jax.device_put(opt_state)
@@ -131,8 +135,19 @@ class ExpertBackend:
         """Run the expert on one padded batch; returns flat output arrays."""
         outputs = self._jit_forward(self.params, tuple(inputs))
         leaves = jax.tree_util.tree_leaves(outputs)
-        self.n_outputs = len(leaves)
+        self._record_output_schema(leaves)
         return leaves
+
+    def _record_output_schema(self, leaves) -> None:
+        """Outputs are row-aligned with inputs (the TaskPool scatters rows
+        back per task), so shape[0] is the batch dim and shape[1:] is the
+        wire-stable per-row schema."""
+        self.n_outputs = len(leaves)
+        self.output_schema = [
+            {"shape": [int(d) for d in np.shape(l)[1:]],
+             "dtype": str(np.dtype(l.dtype))}
+            for l in leaves
+        ]
 
     def backward(
         self, inputs: Sequence[np.ndarray], grad_outputs: Sequence[np.ndarray]
@@ -184,7 +199,7 @@ class ExpertBackend:
             self._jit_forward.lower(self.params, padded).compile()
             out_aval = jax.eval_shape(self._forward_impl, self.params, padded)
             leaves = jax.tree_util.tree_leaves(out_aval)
-            self.n_outputs = len(leaves)
+            self._record_output_schema(leaves)
             grad_out = (
                 leaves[0] if len(leaves) == 1 else tuple(leaves)
             )
@@ -209,6 +224,8 @@ class ExpertBackend:
             from learning_at_home_tpu.utils.nested import schema_from_tree
 
             info["input_schema"] = schema_from_tree(self.input_structure)
+        if self.output_schema is not None:
+            info["output_schema"] = self.output_schema
         return info
 
     def state_dict(self) -> dict:

@@ -1,0 +1,101 @@
+"""Server-published output schemas (info RPC) driving client specs.
+
+Round-1 deferral: clients needed a hand-written ``output_spec_fn`` for any
+expert whose output is not shaped like its first input.  The server now
+records per-leaf output shapes at warmup / first forward and publishes
+them in ``info``; RemoteExpert builds io_callback result specs from that —
+including **multi-output** experts (reference contract: experts are
+arbitrary modules, SURVEY.md §2 RemoteExpert row).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_at_home_tpu.client import RemoteExpert, reset_client_rpc
+from learning_at_home_tpu.server import ExpertBackend, Server
+
+HID = 12
+STATS = 3
+
+
+def _make_backend(warm: bool):
+    def apply_fn(params, x):
+        y = jnp.tanh(x @ params["w"])
+        stats = y[:, :STATS] * params["gain"]
+        return y, stats  # two outputs, second NOT input-shaped
+
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (HID, HID)) * 0.3,
+        "gain": jnp.float32(2.0),
+    }
+    backend = ExpertBackend(
+        "multi.0", apply_fn, params, optax.sgd(0.01), max_batch_size=64
+    )
+    if warm:
+        backend.warmup([np.zeros((4, HID), np.float32)], buckets=[4, 64])
+    return backend
+
+
+@pytest.fixture(scope="module")
+def schema_server():
+    server = Server({"multi.0": _make_backend(warm=True)}, host="127.0.0.1")
+    server.run_in_background()
+    yield server
+    server.shutdown()
+    reset_client_rpc()
+
+
+def test_info_publishes_output_schema(schema_server):
+    expert = RemoteExpert("multi.0", schema_server.endpoint)
+    schema = expert.info()["output_schema"]
+    assert schema == [
+        {"shape": [HID], "dtype": "float32"},
+        {"shape": [STATS], "dtype": "float32"},
+    ]
+
+
+def test_multi_output_forward_and_grad_without_spec_fn(schema_server):
+    """No output_spec_fn anywhere: the published schema drives the client."""
+    expert = RemoteExpert("multi.0", schema_server.endpoint)
+    state = schema_server.experts["multi.0"].state_dict()["params"]
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(4, HID).astype(np.float32))
+
+    y, stats = expert(x)
+    y_exp = np.tanh(np.asarray(x) @ state["w"])
+    np.testing.assert_allclose(np.asarray(y), y_exp, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats), y_exp[:, :STATS] * state["gain"], atol=1e-5
+    )
+
+    # grads flow through BOTH outputs' cotangents, under jit
+    def loss(x):
+        y, stats = expert(x)
+        return jnp.sum(y**2) + jnp.sum(stats)
+
+    g = jax.jit(jax.grad(loss))(x)
+
+    def local_loss(x):
+        y = jnp.tanh(x @ state["w"])
+        return jnp.sum(y**2) + jnp.sum(y[:, :STATS] * state["gain"])
+
+    g_exp = jax.grad(local_loss)(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_exp), atol=1e-4)
+
+
+def test_unwarmed_multi_output_fails_loudly():
+    """Without warmup there is no schema yet; the fallback spec (first
+    input) mismatches a 2-output expert and must raise, not misbind."""
+    server = Server({"multi.0": _make_backend(warm=False)}, host="127.0.0.1")
+    server.run_in_background()
+    try:
+        expert = RemoteExpert("multi.0", server.endpoint)
+        x = jnp.ones((4, HID), jnp.float32)
+        with pytest.raises(Exception, match="returned 2 outputs"):
+            expert(x)
+    finally:
+        server.shutdown()
+        reset_client_rpc()
