@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Gating/scheduling simulation with thousands of experts.
+
+The reference's paper harness includes a routing simulation at grid
+scales far beyond what any one host serves (SURVEY.md §2 "Experiment
+scripts"; [BJ] config 4: 4096-expert grid + DHT beam-search routing).
+This script builds a REAL multi-node DHT swarm in-process, declares an
+E-expert grid spread over many simulated server endpoints, then drives
+batched gate scores through the production beam-search router and
+measures what a scheduler cares about:
+
+- routing latency (p50/p99 per batch) and DHT record reads per batch
+  (the O(beam·dims) contract vs O(grid) enumeration);
+- top-k recall of beam search against exact full-grid enumeration;
+- expert load distribution under skewed gates: max/mean load, normalized
+  selection entropy, and the token fraction a capacity-factor cap would
+  drop (what the pod tier's static capacity slots would cut);
+- quorum coverage when a fraction of the grid is dead.
+
+Example:
+  python experiments/gating_simulation.py --grid 16 16 16 --batches 8
+"""
+
+import argparse
+import asyncio
+import itertools
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+class CountingSource:
+    """ExpertSource proxy counting DHT reads (records fetched, prefixes probed)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.record_reads = 0
+        self.prefix_probes = 0
+
+    async def get_alive_experts(self, prefix):
+        self.record_reads += 1
+        return await self.inner.get_alive_experts(prefix)
+
+    async def first_k_active(self, prefixes, k):
+        self.prefix_probes += len(prefixes)
+        return await self.inner.first_k_active(prefixes, k)
+
+
+def gate_logits(rs, batch, grid, skew):
+    """Per-dimension gate scores; ``skew`` > 0 concentrates mass on low
+    indices (Zipf-like popular experts), stressing load balance."""
+    out = []
+    for g in grid:
+        logits = rs.randn(batch, g).astype(np.float32)
+        if skew:
+            logits -= skew * np.log1p(np.arange(g, dtype=np.float32))[None, :]
+        out.append(logits)
+    return out
+
+
+async def run(args):
+    from learning_at_home_tpu.client.routing import (
+        beam_search_alive,
+        make_uid,
+        select_top_k,
+    )
+    from learning_at_home_tpu.dht import DHT
+
+    grid = tuple(args.grid)
+    n_experts = int(np.prod(grid))
+    rs = np.random.RandomState(args.seed)
+
+    # --- swarm: real DHT nodes, simulated server endpoints ---
+    boot = DHT()
+    nodes = [boot] + [DHT(initial_peers=[boot.endpoint]) for _ in range(args.nodes - 1)]
+    all_coords = list(itertools.product(*(range(g) for g in grid)))
+    all_uids = [make_uid(args.prefix, c) for c in all_coords]
+    alive_mask = rs.rand(n_experts) >= args.dead_fraction
+    alive_uids = [u for u, a in zip(all_uids, alive_mask) if a]
+
+    t0 = time.monotonic()
+    chunks = np.array_split(np.asarray(alive_uids, dtype=object), args.servers)
+    for s, chunk in enumerate(chunks):  # array_split: EVERY alive uid lands
+        if not len(chunk):
+            continue
+        endpoint = (f"10.0.{s // 256}.{s % 256}", 31337)  # simulated peer
+        node = nodes[s % len(nodes)]
+        await node.declare_experts(list(chunk), endpoint, expiration=600.0)
+    declare_s = time.monotonic() - t0
+
+    # --- ground truth for recall: exact top-k over the alive grid ---
+    source = CountingSource(nodes[-1])
+    lat, reads, probes, recalls, coverage = [], [], [], [], []
+    counts = np.zeros(n_experts, dtype=np.int64)
+    uid_to_idx = {u: i for i, u in enumerate(all_uids)}
+    total_tokens = 0
+
+    for _ in range(args.batches):
+        logits = gate_logits(rs, args.batch_size, grid, args.skew)
+        r0, p0 = source.record_reads, source.prefix_probes
+        t = time.monotonic()
+        found = await beam_search_alive(
+            source, args.prefix, logits, grid, beam_size=args.beam
+        )
+        lat.append(time.monotonic() - t)
+        reads.append(source.record_reads - r0)
+        probes.append(source.prefix_probes - p0)
+
+        if not found:
+            recalls.append(0.0)
+            coverage.append(0.0)
+            continue
+        found_sorted = sorted(found)
+        sel, _ = select_top_k(logits, found_sorted, args.k)
+        for row in sel:
+            for j in row:
+                counts[uid_to_idx[found_sorted[j]]] += 1
+        total_tokens += sel.shape[0] * sel.shape[1]
+        coverage.append(1.0 if sel.shape[1] >= args.k else sel.shape[1] / args.k)
+
+        # exact top-k over every ALIVE expert (what an oracle scheduler picks)
+        exact_sel, _ = select_top_k(logits, alive_uids, args.k)
+        exact_hits = 0
+        for b in range(args.batch_size):
+            beam_set = {found_sorted[j] for j in sel[b]}
+            oracle = {alive_uids[j] for j in exact_sel[b]}
+            exact_hits += len(beam_set & oracle) / max(len(oracle), 1)
+        recalls.append(exact_hits / args.batch_size)
+
+    for n in nodes:
+        n.shutdown()
+
+    # --- load statistics over all routed tokens ---
+    load_stats = {}
+    if total_tokens:
+        # all load statistics are over SERVABLE (alive) experts — dead
+        # slots can never be selected and must not dilute the mean
+        alive_counts = counts[alive_mask]
+        p = alive_counts / alive_counts.sum()
+        nz = p[p > 0]
+        entropy = float(-(nz * np.log(nz)).sum() / np.log(len(alive_uids)))
+        cap = int(np.ceil(args.capacity_factor * total_tokens / len(alive_uids)))
+        dropped = int(np.maximum(alive_counts - cap, 0).sum())
+        load_stats = {
+            "experts_touched": int((alive_counts > 0).sum()),
+            "max_over_mean_load": round(
+                float(alive_counts.max() / max(alive_counts.mean(), 1e-9)), 1
+            ),
+            "selection_entropy": round(entropy, 4),  # 1.0 = perfectly uniform
+            "capacity_dropped_fraction": round(dropped / total_tokens, 4),
+        }
+
+    la = np.asarray(lat) * 1000
+    return {
+        "metric": "gating simulation",
+        "experts": n_experts,
+        "grid": list(grid),
+        "alive": int(alive_mask.sum()),
+        "servers": args.servers,
+        "dht_nodes": args.nodes,
+        "declare_s": round(declare_s, 1),
+        "routing_ms": {"p50": round(float(np.percentile(la, 50)), 1),
+                       "p99": round(float(np.percentile(la, 99)), 1)},
+        "record_reads_per_batch": round(float(np.mean(reads)), 1),
+        "prefix_probes_per_batch": round(float(np.mean(probes)), 1),
+        "enumeration_reads_equiv": n_experts,
+        "beam_recall_vs_exact": round(float(np.mean(recalls)), 4),
+        "quorum_coverage": round(float(np.mean(coverage)), 4),
+        "skew": args.skew,
+        **load_stats,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--grid", type=int, nargs="+", default=[16, 16, 16])
+    p.add_argument("--prefix", default="ffn")
+    p.add_argument("--nodes", type=int, default=4, help="DHT swarm size")
+    p.add_argument("--servers", type=int, default=32,
+                   help="simulated expert-hosting peers")
+    p.add_argument("--batches", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--beam", type=int, default=8)
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--skew", type=float, default=0.5,
+                   help="Zipf-like gate skew toward low indices")
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--dead-fraction", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    print(json.dumps(asyncio.run(run(args))))
+
+
+if __name__ == "__main__":
+    main()

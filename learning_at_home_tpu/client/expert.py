@@ -21,6 +21,7 @@ import logging
 from typing import Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.experimental import io_callback
 
@@ -165,18 +166,33 @@ class RemoteExpert:
                 if isinstance(grad_out, (tuple, list))
                 else [grad_out]
             )
-            in_specs = tuple(
-                jax.ShapeDtypeStruct(np.shape(x), x.dtype) for x in residual_inputs
-            )
             n_in = len(residual_inputs)
-            return io_callback(
-                lambda *args: tuple(
-                    np.asarray(g, dtype=s.dtype)
-                    for g, s in zip(host_backward(n_in, args), in_specs)
-                ),
-                in_specs,
-                *residual_inputs,
-                *grads_out,
+            # integer wire inputs (e.g. det_dropout's per-row seed) take
+            # float0 cotangents, which io_callback cannot produce — the
+            # callback ships ALL inputs to the server (it needs them to
+            # re-forward) but returns grads only for the float primals
+            diff_idx = tuple(
+                i for i, x in enumerate(residual_inputs)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+            )
+            diff_specs = tuple(
+                jax.ShapeDtypeStruct(
+                    np.shape(residual_inputs[i]), residual_inputs[i].dtype
+                )
+                for i in diff_idx
+            )
+            def cb(*args):
+                grads = host_backward(n_in, args)
+                return tuple(
+                    np.asarray(grads[i], dtype=s.dtype)
+                    for i, s in zip(diff_idx, diff_specs)
+                )
+
+            diff_grads = io_callback(cb, diff_specs, *residual_inputs, *grads_out)
+            by_idx = dict(zip(diff_idx, diff_grads))
+            return tuple(
+                by_idx.get(i, np.zeros(np.shape(x), jax.dtypes.float0))
+                for i, x in enumerate(residual_inputs)
             )
 
         remote_call.defvjp(fwd, bwd)

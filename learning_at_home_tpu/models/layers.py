@@ -75,6 +75,52 @@ class SwiGLUBlock(nn.Module):
         return x + h
 
 
+class DeterministicDropoutBlock(nn.Module):
+    """FFN expert with dropout that is a pure function of a per-row seed.
+
+    The reference ships a deterministic-dropout layer because its server
+    RE-RUNS forward inside backward (autograd re-execution) — a stateful
+    dropout mask would differ between the two passes and corrupt the
+    gradients (SURVEY.md §3.2; ``hivemind/server/layers`` det-dropout,
+    unverifiable refs, mount empty).  Same constraint here: backward is
+    one jitted ``jax.vjp`` re-forward (``expert_backend.py``), so the mask
+    must derive only from wire inputs.  The client sends a per-row int32
+    ``seed`` tensor alongside ``x``; the mask is a counter-based hash of
+    the seed (threefry via ``jax.random``) — identical on forward and on
+    backward's re-forward because both see the same wire rows, and
+    trivially vmappable/XLA-fusible (no RNG state anywhere).
+    """
+
+    hidden_dim: int
+    rate: float = 0.1
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def wire_inputs(hidden_dim: int, rows: int) -> list:
+        """x plus a per-row int32 mask seed (see sample_inputs)."""
+        import numpy as np
+
+        return [
+            np.zeros((rows, hidden_dim), np.float32),
+            np.arange(rows, dtype=np.int32),
+        ]
+
+    @nn.compact
+    def __call__(self, x, seed):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(4 * self.hidden_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        keep = 1.0 - self.rate
+        masks = jax.vmap(
+            lambda s: jax.random.bernoulli(
+                jax.random.PRNGKey(s), keep, (4 * self.hidden_dim,)
+            )
+        )(seed)
+        h = h * masks.astype(h.dtype) / keep
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype)(h)
+        return x + h
+
+
 class NopBlock(nn.Module):
     """Identity expert — used by throughput benchmarks to isolate the
     batching/transport overhead from compute."""
@@ -93,16 +139,43 @@ name_to_block: dict[str, Callable[..., nn.Module]] = {
     "ffn": FeedforwardBlock,
     "transformer": TransformerEncoderBlock,
     "swiglu": SwiGLUBlock,
+    "det_dropout": DeterministicDropoutBlock,
     "nop": NopBlock,
 }
 
 
+def sample_inputs(expert_cls: str, hidden_dim: int, rows: int = 2) -> list:
+    """One example row-batch per wire input for a registry expert —
+    drives init, warmup bucket compilation, and ``n_inputs``.
+
+    Arity knowledge lives ON the block: a multi-input block declares a
+    ``wire_inputs(hidden_dim, rows)`` staticmethod (see
+    ``DeterministicDropoutBlock``); blocks without one take the standard
+    single ``[rows, hidden]`` tensor."""
+    import numpy as np
+
+    block_cls = name_to_block[expert_cls]
+    wire = getattr(block_cls, "wire_inputs", None)
+    if wire is not None:
+        return wire(hidden_dim, rows)
+    return [np.zeros((rows, hidden_dim), np.float32)]
+
+
 def make_expert(
-    expert_cls: str, hidden_dim: int, rng: jax.Array, sample_input, dtype=jnp.float32
+    expert_cls: str,
+    hidden_dim: int,
+    rng: jax.Array,
+    sample_input=None,
+    dtype=jnp.float32,
 ) -> tuple[Callable, Any]:
     """Build ``(apply_fn, params)`` for an ExpertBackend from a registry name."""
     module = name_to_block[expert_cls](hidden_dim=hidden_dim, dtype=dtype)
-    params = module.init(rng, sample_input)
+    samples = (
+        [sample_input]
+        if sample_input is not None
+        else sample_inputs(expert_cls, hidden_dim)
+    )
+    params = module.init(rng, *samples)
 
     def apply_fn(params, *inputs):
         return module.apply(params, *inputs)

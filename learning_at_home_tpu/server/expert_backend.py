@@ -25,6 +25,7 @@ import threading
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -122,6 +123,18 @@ class ExpertBackend:
             lambda p, xs: self._apply(p, xs), params, inputs
         )
         param_grads, input_grads = vjp_fn(grad_outputs)
+        # integer wire inputs (e.g. det_dropout's per-row seed) get float0
+        # cotangents, which cannot travel the wire — ship f32 zeros; the
+        # client discards grads for its integer primals anyway
+        input_grads = jax.tree_util.tree_map(
+            lambda x, g: (
+                jnp.zeros(jnp.shape(x), jnp.float32)
+                if getattr(g, "dtype", None) == jax.dtypes.float0
+                else g
+            ),
+            inputs,
+            input_grads,
+        )
         updates, new_opt_state = self.optimizer.update(
             param_grads, opt_state, params
         )

@@ -121,6 +121,7 @@ class Server:
         import zlib
 
         from learning_at_home_tpu.models import make_expert
+        from learning_at_home_tpu.models.layers import sample_inputs
 
         optimizer = optimizer if optimizer is not None else optax.adam(1e-3)
         if expert_uids is not None:
@@ -134,20 +135,18 @@ class Server:
                 for i in range(expert_offset, expert_offset + num_experts)
             ]
         experts = {}
+        n_wire_inputs = len(sample_inputs(expert_cls, hidden_dim))
         for uid, key in uid_keys:
-            apply_fn, params = make_expert(
-                expert_cls, hidden_dim, key, jnp.zeros((2, hidden_dim))
-            )
+            apply_fn, params = make_expert(expert_cls, hidden_dim, key)
             experts[uid] = ExpertBackend(
-                uid, apply_fn, params, optimizer, max_batch_size=max_batch_size
+                uid, apply_fn, params, optimizer,
+                max_batch_size=max_batch_size, n_inputs=n_wire_inputs,
             )
         if warmup:
             import time as _time
 
-            import numpy as np
-
             t0 = _time.monotonic()
-            sample = [np.zeros((1, hidden_dim), np.float32)]
+            sample = sample_inputs(expert_cls, hidden_dim, rows=1)
             buckets = None if warmup is True else list(warmup)
             n = sum(
                 backend.warmup(sample, buckets=buckets)
