@@ -88,41 +88,48 @@ class DMoETransformerLM:
     # ---- parameters ----
 
     def init_params(self, rng: jax.Array) -> Params:
+        """Layer params are STACKED (leading ``n_layers`` dim on every
+        leaf) and the forward scans over them — one compiled layer body
+        instead of ``n_layers`` inlined copies, which divides HLO size and
+        compile time by ~L for the 256-expert flagship."""
         cfg = self.cfg
         d, v, s = cfg.d_model, cfg.vocab_size, cfg.seq_len
         dense = jax.nn.initializers.lecun_normal()
         embed_init = jax.nn.initializers.normal(1.0 / np.sqrt(d))
-        keys = iter(jax.random.split(rng, 4 + 6 * cfg.n_layers))
+        k_embed, k_pos, k_head, k_layers = jax.random.split(rng, 4)
         pdt = cfg.param_dtype
 
         def ln():
             return {"scale": jnp.ones((d,), pdt), "bias": jnp.zeros((d,), pdt)}
 
+        def init_layer(key):
+            ks = jax.random.split(key, 5)
+            return {
+                "ln1": ln(),
+                "wq": dense(ks[0], (d, d), pdt),
+                "wk": dense(ks[1], (d, d), pdt),
+                "wv": dense(ks[2], (d, d), pdt),
+                "wo": dense(ks[3], (d, d), pdt),
+                "ln2": ln(),
+                "moe": self.moe.init_params(ks[4], device_put=False),
+            }
+
         params: dict = {
-            "embed": embed_init(next(keys), (v, d), pdt),
-            "pos": embed_init(next(keys), (s, d), pdt),
+            "embed": embed_init(k_embed, (v, d), pdt),
+            "pos": embed_init(k_pos, (s, d), pdt),
             "ln_f": ln(),
-            "layers": [],
+            "layers": jax.vmap(init_layer)(
+                jax.random.split(k_layers, cfg.n_layers)
+            ),
         }
         if not cfg.tie_embeddings:
-            params["lm_head"] = dense(next(keys), (d, v), pdt)
-        for _ in range(cfg.n_layers):
-            params["layers"].append(
-                {
-                    "ln1": ln(),
-                    "wq": dense(next(keys), (d, d), pdt),
-                    "wk": dense(next(keys), (d, d), pdt),
-                    "wv": dense(next(keys), (d, d), pdt),
-                    "wo": dense(next(keys), (d, d), pdt),
-                    "ln2": ln(),
-                    "moe": self.moe.init_params(next(keys)),
-                }
-            )
+            params["lm_head"] = dense(k_head, (d, v), pdt)
         return jax.device_put(params, self.param_shardings(params))
 
     def param_shardings(self, params_shape: Params) -> Params:
-        """Replicated everywhere except the expert stacks."""
-        moe_shardings = self.moe.param_shardings()
+        """Replicated everywhere except the expert stacks (whose specs gain
+        a leading ``None`` for the stacked layer dim)."""
+        stacked_moe = self.moe.param_shardings(stacked=True)
         repl = NamedSharding(self.mesh, P())
 
         def assign(path, leaf):
@@ -130,7 +137,7 @@ class DMoETransformerLM:
                 name = getattr(p, "key", getattr(p, "name", None))
                 if name == "moe":
                     inner = path[-1]
-                    return moe_shardings[getattr(inner, "key", None)]
+                    return stacked_moe[getattr(inner, "key", None)]
             return repl
 
         return jax.tree_util.tree_map_with_path(assign, params_shape)
@@ -160,10 +167,14 @@ class DMoETransformerLM:
         layer_fn = self._layer
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)
-        aux_total = {"aux_loss": 0.0, "router_z_loss": 0.0, "dropped_fraction": 0.0}
-        for lp in params["layers"]:
+
+        def body(x, lp):
             x, aux = layer_fn(lp, x)
-            aux_total = {k: aux_total[k] + aux[k] for k in aux_total}
+            return x, aux
+
+        # scan over the stacked layer params: ONE compiled layer body
+        x, aux_stack = jax.lax.scan(body, x, params["layers"])
+        aux_total = {k: jnp.sum(v) for k, v in aux_stack.items()}
         x = layer_norm(params["ln_f"], x)
         head = (
             params["embed"].T if cfg.tie_embeddings else params["lm_head"]

@@ -106,7 +106,9 @@ class ShardedMixtureOfExperts:
 
     # ---- parameters ----
 
-    def init_params(self, rng: jax.Array) -> Params:
+    def init_params(self, rng: jax.Array, device_put: bool = True) -> Params:
+        """``device_put=False`` returns the raw tree (for callers that
+        stack layers under vmap and shard the stacked result themselves)."""
         kg, k1, k2 = jax.random.split(rng, 3)
         d, e, f = self.hidden_dim, self.num_experts, self.ffn_dim
         init = jax.nn.initializers.lecun_normal()
@@ -117,6 +119,8 @@ class ShardedMixtureOfExperts:
             "w2": init(k2, (e, f, d), self.param_dtype),
             "b2": jnp.zeros((e, d), self.param_dtype),
         }
+        if not device_put:
+            return params
         return jax.device_put(params, self.param_shardings())
 
     def _expert_param_specs(self) -> dict[str, P]:
@@ -130,13 +134,20 @@ class ShardedMixtureOfExperts:
         return {"w1": P("expert"), "b1": P("expert"),
                 "w2": P("expert"), "b2": P("expert")}
 
-    def param_shardings(self) -> dict[str, NamedSharding]:
-        out = {
+    def param_specs(self, stacked: bool = False) -> dict[str, P]:
+        """PartitionSpec per param; ``stacked=True`` prepends a ``None``
+        dim for callers that stack layers of MoE params (lax.scan)."""
+        specs = dict(self._expert_param_specs())
+        specs["gate"] = P()
+        if stacked:
+            specs = {name: P(None, *spec) for name, spec in specs.items()}
+        return specs
+
+    def param_shardings(self, stacked: bool = False) -> dict[str, NamedSharding]:
+        return {
             name: NamedSharding(self.mesh, spec)
-            for name, spec in self._expert_param_specs().items()
+            for name, spec in self.param_specs(stacked).items()
         }
-        out["gate"] = NamedSharding(self.mesh, P())
-        return out
 
     # ---- the sharded program ----
 

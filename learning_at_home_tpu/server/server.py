@@ -24,7 +24,6 @@ import threading
 from typing import Any, Optional
 
 import jax
-import jax.numpy as jnp
 import optax
 
 from learning_at_home_tpu.server.connection_handler import ConnectionHandler

@@ -55,9 +55,9 @@ def test_train_checkpointer_roundtrip_sharded(tmp_path):
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # sharding preserved on expert stacks
-    assert rparams["layers"][0]["moe"]["w1"].sharding.spec == params[
+    assert rparams["layers"]["moe"]["w1"].sharding.spec == params[
         "layers"
-    ][0]["moe"]["w1"].sharding.spec
+    ]["moe"]["w1"].sharding.spec
     # resumed training continues identically
     _, _, loss_resumed, _ = step_fn(rparams, ropt, ids, tgt)
     _, _, loss_orig, _ = step_fn(params, opt_state, ids, tgt)

@@ -171,7 +171,7 @@ def test_transformer_trains_and_keeps_shardings():
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     assert np.isfinite(losses).all()
-    w1 = params["layers"][0]["moe"]["w1"]
+    w1 = params["layers"]["moe"]["w1"]
     assert "expert" in str(w1.sharding.spec)
 
 
