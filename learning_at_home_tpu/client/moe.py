@@ -47,6 +47,7 @@ from learning_at_home_tpu.client.routing import (
     select_top_k,
 )
 from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+from learning_at_home_tpu.utils.connection import RemoteCallError
 from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)
@@ -96,6 +97,7 @@ class RemoteMixtureOfExperts:
         compute_dtype=jnp.float32,
         routing: str = "enumerate",
         beam_size: int = 8,
+        merge_rpcs: bool = True,
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
@@ -114,6 +116,9 @@ class RemoteMixtureOfExperts:
         self.compute_dtype = compute_dtype
         self.routing = routing
         self.beam_size = beam_size
+        # one 'multi' request per peer (overhead per PEER not per expert);
+        # False restores the reference's strictly per-expert fan-out
+        self.merge_rpcs = merge_rpcs
         self.source = source
         self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
         self._sessions: OrderedDict[int, dict] = OrderedDict()
@@ -403,31 +408,96 @@ class RemoteMixtureOfExperts:
     async def _quorum_fanout(
         self, msg_type: str, jobs: dict, batch: int, quorum: int, rpc_timeout: float
     ) -> dict:
-        """Run all RPCs in parallel; once every sample has ≥ quorum successful
-        replies, wait a grace period then cancel stragglers (the reference's
-        k_min + timeout_after_k_min contract)."""
+        """Run the fan-out in parallel; once every sample has ≥ quorum
+        successful replies, wait a grace period then cancel stragglers (the
+        reference's k_min + timeout_after_k_min contract).
+
+        Jobs for experts co-hosted on ONE endpoint travel as a single
+        ``multi`` request (per-part replies) — per-request overhead is paid
+        per peer, not per expert, and the failure/straggler granularity
+        this coarsens to is the real one: co-hosted experts share a
+        process, so they die (and straggle) together anyway."""
         loop = asyncio.get_running_loop()
         registry = pool_registry()
+        groups: dict = {}  # endpoint -> [uid, ...]
+        for uid, job in jobs.items():
+            groups.setdefault(job[0], []).append(uid)
+        group_list = list(groups.items())
+        if not self.merge_rpcs:
+            group_list = [
+                (ep, [uid]) for ep, uids in group_list for uid in uids
+            ]
 
-        async def call(uid, job):
-            if msg_type == "forward":
-                endpoint, x_rows, rows, slots = job
-                tensors, _ = await registry.get(endpoint).rpc(
-                    "forward", [x_rows], {"uid": uid}, timeout=rpc_timeout
+        async def call_single(endpoint, uid) -> dict:
+            job = jobs[uid]
+            payload = [job[1]] if msg_type == "forward" else [job[1], job[4]]
+            meta = (
+                {"uid": uid}
+                if msg_type == "forward"
+                else {"uid": uid, "n_inputs": 1}
+            )
+            tensors, _ = await registry.get(endpoint).rpc(
+                msg_type, payload, meta, timeout=rpc_timeout
+            )
+            return {uid: tensors}
+
+        async def call_group(endpoint, uids) -> dict:
+            """Returns uid -> reply tensors (None for failed parts)."""
+            if len(uids) == 1:
+                return await call_single(endpoint, uids[0])
+            parts, payload = [], []
+            for uid in uids:
+                job = jobs[uid]
+                t = [job[1]] if msg_type == "forward" else [job[1], job[4]]
+                part = {"uid": uid, "n_tensors": len(t)}
+                if msg_type == "backward":
+                    part["n_inputs"] = 1
+                parts.append(part)
+                payload.extend(t)
+            reply_tensors, reply_meta = await registry.get(endpoint).rpc(
+                "multi", payload, {"op": msg_type, "parts": parts},
+                timeout=rpc_timeout,
+            )
+            # reply meta is peer-supplied: any structural lie fails the
+            # whole group (equivalent to a failed RPC), never misbinds
+            rparts = reply_meta.get("parts")
+            if not isinstance(rparts, list) or len(rparts) != len(uids):
+                raise RemoteCallError(f"{endpoint}: malformed multi reply")
+            out, off = {}, 0
+            for uid, rp in zip(uids, rparts):
+                if not isinstance(rp, dict) or rp.get("uid") != uid:
+                    raise RemoteCallError(
+                        f"{endpoint}: multi reply part order mismatch"
+                    )
+                if rp.get("ok"):
+                    n = rp.get("n_tensors")
+                    if (
+                        not isinstance(n, int) or n < 0
+                        or off + n > len(reply_tensors)
+                    ):
+                        raise RemoteCallError(
+                            f"{endpoint}: multi reply tensor counts lie"
+                        )
+                    out[uid] = reply_tensors[off : off + n]
+                    off += n
+                else:
+                    logger.warning(
+                        "%s multi part for %s failed at %s: %s",
+                        msg_type, uid, endpoint, rp.get("message"),
+                    )
+                    out[uid] = None
+            if off != len(reply_tensors):
+                raise RemoteCallError(
+                    f"{endpoint}: multi reply parts cover {off} tensors, "
+                    f"reply has {len(reply_tensors)}"
                 )
-            else:
-                endpoint, x_rows, rows, slots, grad_rows = job
-                tensors, _ = await registry.get(endpoint).rpc(
-                    "backward",
-                    [x_rows, grad_rows],
-                    {"uid": uid, "n_inputs": 1},
-                    timeout=rpc_timeout,
-                )
-            return tensors
+            return out
 
         pending = {
-            asyncio.ensure_future(call(uid, job)): uid for uid, job in jobs.items()
+            asyncio.ensure_future(call_group(ep, uids)): (ep, uids)
+            for ep, uids in group_list
         }
+        retried: set = set()  # endpoints whose merged call was disaggregated
         rows_of = {uid: job[2] for uid, job in jobs.items()}
         per_sample = np.zeros(batch, np.int64)
         results = {uid: (*job, None) for uid, job in jobs.items()}
@@ -440,33 +510,54 @@ class RemoteMixtureOfExperts:
             if not done:
                 break  # grace period expired — drop stragglers
             for task in done:
-                uid = pending.pop(task)
+                endpoint, uids = pending.pop(task)
                 try:
-                    tensors = task.result()
+                    group_replies = task.result()
                 except Exception as e:
                     logger.warning(
-                        "%s RPC to %s failed: %s: %s",
-                        msg_type,
-                        uid,
-                        type(e).__name__,
-                        e,
+                        "%s RPC to %s (%d experts) failed: %s: %s",
+                        msg_type, endpoint, len(uids), type(e).__name__, e,
                     )
+                    # a MERGED request is one fate-shared unit; a transient
+                    # whole-group failure (reply drop, timeout) must not
+                    # cost the per-expert independence the k-of-n quorum
+                    # exploits — disaggregate ONCE into per-expert singles.
+                    # FORWARD ONLY: backward applies the server-side
+                    # optimizer step as a side effect, and a lost REPLY
+                    # does not mean the request wasn't executed — a retry
+                    # would apply the same gradients twice.  Failed
+                    # backward groups just count as missing, exactly like
+                    # the per-expert fan-out with no retry.
+                    if (
+                        msg_type == "forward"
+                        and len(uids) > 1
+                        and endpoint not in retried
+                    ):
+                        retried.add(endpoint)
+                        for uid in uids:
+                            pending[
+                                asyncio.ensure_future(call_single(endpoint, uid))
+                            ] = (endpoint, [uid])
                     continue
-                # row-count check HERE, before the reply counts toward
-                # quorum: a fast wrong-shaped (buggy/malicious) reply must
-                # not arm the grace deadline and get honest stragglers
-                # cancelled (callers re-validate the full shape)
-                if not tensors or tensors[0].shape[0] != len(rows_of[uid]):
-                    logger.warning(
-                        "%s reply from %s has %s rows, expected %d — "
-                        "treating as failed",
-                        msg_type, uid,
-                        tensors[0].shape[0] if tensors else "no",
-                        len(rows_of[uid]),
-                    )
-                    continue
-                results[uid] = (*jobs[uid], tensors)
-                per_sample[rows_of[uid]] += 1
+                for uid in uids:
+                    tensors = group_replies.get(uid)
+                    if tensors is None:
+                        continue
+                    # row-count check HERE, before the reply counts toward
+                    # quorum: a fast wrong-shaped (buggy/malicious) reply
+                    # must not arm the grace deadline and get honest
+                    # stragglers cancelled (callers re-validate full shapes)
+                    if not tensors or tensors[0].shape[0] != len(rows_of[uid]):
+                        logger.warning(
+                            "%s reply from %s has %s rows, expected %d — "
+                            "treating as failed",
+                            msg_type, uid,
+                            tensors[0].shape[0] if tensors else "no",
+                            len(rows_of[uid]),
+                        )
+                        continue
+                    results[uid] = (*jobs[uid], tensors)
+                    per_sample[rows_of[uid]] += 1
             if deadline is None:
                 # arm the grace period once every sample is either quorate
                 # or HOPELESS (even if all its still-pending RPCs landed it
@@ -475,8 +566,9 @@ class RemoteMixtureOfExperts:
                 # (A black-holed-but-pending RPC still counts as hope; the
                 # hard bound for those is rpc_timeout.)
                 still_possible = np.zeros(batch, np.int64)
-                for uid in pending.values():
-                    still_possible[rows_of[uid]] += 1
+                for _, uids in pending.values():
+                    for uid in uids:
+                        still_possible[rows_of[uid]] += 1
                 settled = (per_sample >= quorum) | (
                     per_sample + still_possible < quorum
                 )

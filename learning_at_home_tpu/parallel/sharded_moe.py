@@ -170,7 +170,7 @@ class ShardedMixtureOfExperts:
             functools.partial(self._local_forward, capacity=capacity),
             mesh=self.mesh,
             in_specs=(
-                {"gate": P(), **self._expert_param_specs()},
+                self.param_specs(),
                 P(self._shard),
             ),
             out_specs=(

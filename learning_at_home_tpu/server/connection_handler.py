@@ -13,6 +13,15 @@ Wire protocol (see utils/serialization.py for framing):
 - ``backward``: meta {uid, n_inputs}, tensors [*inputs, *grad_outputs]
                                                           → ``result`` [*input_grads]
 - ``info``:     meta {uid}                                → ``result`` meta=info
+- ``multi``:    meta {op: forward|backward,
+                      parts: [{uid, n_tensors}...]},
+                tensors = concatenation in parts order     → ``result``
+                meta {parts: [{uid, ok, n_tensors, message?}...]},
+                tensors = concatenation of successful parts' outputs.
+                ONE request serves every expert a client picked on this
+                server — the swarm fan-out pays per-request overhead per
+                PEER, not per expert (failure granularity is per-peer
+                anyway: co-hosted experts die together).
 - errors                                                  → ``error`` meta {message}
 """
 
@@ -61,62 +70,134 @@ class ConnectionHandler:
         finally:
             writer.close()
 
+    # ---- per-op execution (validation + pool submit), shared by the
+    #      single-expert and multi-expert paths; raises on any failure ----
+
+    async def _run_forward(self, uid: str, tensors) -> list:
+        backend = self.server.experts.get(uid)
+        if backend is None:
+            raise ValueError(f"unknown expert uid: {uid!r}")
+        if len(tensors) != backend.n_inputs:
+            # reject HERE: a wrong-arity task reaching the pool would
+            # poison the whole formed batch (innocent co-batched
+            # requests fail with it)
+            raise ValueError(
+                f"expert {uid} takes {backend.n_inputs} inputs, "
+                f"got {len(tensors)}"
+            )
+        return await self.server.forward_pools[uid].submit_task(*tensors)
+
+    async def _run_backward(self, uid: str, tensors, declared_n_inputs) -> list:
+        backend = self.server.experts.get(uid)
+        if backend is None:
+            raise ValueError(f"unknown expert uid: {uid!r}")
+        n_inputs = (
+            int(declared_n_inputs)
+            if declared_n_inputs is not None
+            else backend.n_inputs
+        )
+        if n_inputs != backend.n_inputs:
+            raise ValueError(
+                f"expert {uid} takes {backend.n_inputs} inputs, "
+                f"request declared {n_inputs}"
+            )
+        # mirror the forward guard: a backward request carries the
+        # inputs PLUS the grad_outputs; wrong arity in EITHER
+        # direction must be rejected before it can poison a formed
+        # batch (exact check once n_outputs is known, i.e. after
+        # warmup or the first forward)
+        expected = (
+            backend.n_inputs + backend.n_outputs
+            if backend.n_outputs is not None
+            else None
+        )
+        if (expected is not None and len(tensors) != expected) or (
+            len(tensors) <= backend.n_inputs
+        ):
+            raise ValueError(
+                f"backward for {uid} needs "
+                f"{expected or f'>{backend.n_inputs}'} tensors "
+                f"(inputs + grad_outputs), got {len(tensors)}"
+            )
+        return await self.server.backward_pools[uid].submit_task(*tensors)
+
+    async def _run_multi(self, tensors, meta) -> bytes:
+        """Fan a merged request out to the local expert pools concurrently;
+        per-part failures are reported per part, not as a whole-request
+        error.  All meta is peer-supplied — validate structurally."""
+        op = meta.get("op")
+        parts = meta.get("parts")
+        if op not in ("forward", "backward") or not isinstance(parts, list):
+            raise ValueError("multi needs op forward|backward and parts list")
+        slices = []
+        off = 0
+        for part in parts:
+            if not isinstance(part, dict):
+                raise ValueError("multi part must be a dict")
+            n = part.get("n_tensors")
+            if not isinstance(n, int) or n < 0 or off + n > len(tensors):
+                raise ValueError("multi part tensor counts are inconsistent")
+            slices.append((part, tensors[off : off + n]))
+            off += n
+        if off != len(tensors):
+            raise ValueError(
+                f"multi parts cover {off} tensors, request has {len(tensors)}"
+            )
+
+        async def run_part(part, part_tensors):
+            uid = part.get("uid")
+            if op == "forward":
+                return await self._run_forward(uid, part_tensors)
+            return await self._run_backward(uid, part_tensors, part.get("n_inputs"))
+
+        settled = await asyncio.gather(
+            *(run_part(p, t) for p, t in slices), return_exceptions=True
+        )
+        reply_parts, reply_tensors = [], []
+        for (part, _), result in zip(slices, settled):
+            uid = part.get("uid")
+            if isinstance(result, BaseException):
+                logger.warning(
+                    "multi %s part failed for expert %s: %s", op, uid, result
+                )
+                reply_parts.append(
+                    {"uid": uid, "ok": False,
+                     "message": f"{type(result).__name__}: {result}"}
+                )
+            else:
+                reply_parts.append(
+                    {"uid": uid, "ok": True, "n_tensors": len(result)}
+                )
+                reply_tensors.extend(result)
+        return pack_message("result", reply_tensors, {"parts": reply_parts})
+
     async def _dispatch(self, payload: bytes) -> bytes:
         try:
             msg_type, tensors, meta = unpack_message(payload)
         except Exception as e:
             return pack_message("error", meta={"message": f"malformed request: {e}"})
         uid = meta.get("uid")
-        backend = self.server.experts.get(uid)
-        if backend is None:
-            return pack_message(
-                "error", meta={"message": f"unknown expert uid: {uid!r}"}
-            )
         try:
             if msg_type == "forward":
-                if len(tensors) != backend.n_inputs:
-                    # reject HERE: a wrong-arity task reaching the pool would
-                    # poison the whole formed batch (innocent co-batched
-                    # requests fail with it)
-                    raise ValueError(
-                        f"expert {uid} takes {backend.n_inputs} inputs, "
-                        f"got {len(tensors)}"
-                    )
-                outputs = await self.server.forward_pools[uid].submit_task(*tensors)
-                return pack_message("result", outputs)
-            elif msg_type == "backward":
-                n_inputs = int(meta.get("n_inputs", backend.n_inputs))
-                if n_inputs != backend.n_inputs:
-                    raise ValueError(
-                        f"expert {uid} takes {backend.n_inputs} inputs, "
-                        f"request declared {n_inputs}"
-                    )
-                # mirror the forward guard: a backward request carries the
-                # inputs PLUS the grad_outputs; wrong arity in EITHER
-                # direction must be rejected before it can poison a formed
-                # batch (exact check once n_outputs is known, i.e. after
-                # warmup or the first forward)
-                expected = (
-                    backend.n_inputs + backend.n_outputs
-                    if backend.n_outputs is not None
-                    else None
+                return pack_message(
+                    "result", await self._run_forward(uid, tensors)
                 )
-                if (expected is not None and len(tensors) != expected) or (
-                    len(tensors) <= backend.n_inputs
-                ):
-                    raise ValueError(
-                        f"backward for {uid} needs "
-                        f"{expected or f'>{backend.n_inputs}'} tensors "
-                        f"(inputs + grad_outputs), got {len(tensors)}"
-                    )
-                outputs = await self.server.backward_pools[uid].submit_task(*tensors)
-                return pack_message("result", outputs)
+            elif msg_type == "backward":
+                return pack_message(
+                    "result",
+                    await self._run_backward(uid, tensors, meta.get("n_inputs")),
+                )
+            elif msg_type == "multi":
+                return await self._run_multi(tensors, meta)
             elif msg_type == "info":
+                backend = self.server.experts.get(uid)
+                if backend is None:
+                    raise ValueError(f"unknown expert uid: {uid!r}")
                 return pack_message("result", meta=backend.get_info())
             else:
                 return pack_message(
                     "error", meta={"message": f"unknown message type {msg_type!r}"}
                 )
         except Exception as e:
-            logger.exception("request %s failed for expert %s", msg_type, uid)
+            logger.exception("request %s failed (expert %s)", msg_type, uid)
             return pack_message("error", meta={"message": f"{type(e).__name__}: {e}"})
