@@ -57,7 +57,8 @@ def parse_args():
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--log-every", type=int, default=10)
-    p.add_argument("--checkpoint-dir", default=None, help="pod mode")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="trainer-side checkpoints (pod and swarm modes)")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="steps between checkpoints (0 = end of run only)")
     p.add_argument("--resume", action="store_true")
@@ -170,6 +171,15 @@ def run_swarm(args):
     )
     from learning_at_home_tpu.server import ExpertBackend, Server
 
+    if args.pipeline > 1 and not args.subprocess_servers:
+        print(
+            "# WARNING: --pipeline > 1 with in-process servers is unreliable:"
+            " each in-flight step parks a blocking host callback on an XLA"
+            " CPU execution slot the co-hosted servers need, which can"
+            " starve backward RPCs into total-failure timeouts. Use"
+            " --subprocess-servers (the production topology).",
+            flush=True,
+        )
     # grid: experts_per_layer experts in one dimension per layer; experts
     # strided across servers
     grid = (args.experts_per_layer,)
@@ -275,8 +285,25 @@ def run_swarm(args):
     opt_state = optimizer.init(params)
     step_fn = model.make_train_step(optimizer)
 
+    # client-side recovery (§5.4): the trainer's trunk+gate params resume
+    # from a checkpoint; expert params recover via the SERVER's per-expert
+    # checkpoints (server --resume) — two halves of one contract
+    ckpt = start_step = None
+    if args.checkpoint_dir:
+        from learning_at_home_tpu.utils.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        start_step = 0
+        if args.resume:
+            restored = ckpt.restore_latest(params, opt_state)
+            if restored is not None:
+                start_step, params, opt_state = restored
+                print(f"# resumed trainer from step {start_step}", flush=True)
+
     tokens = load_corpus(args.data, seed=args.seed)
     batches = LMBatcher(tokens, args.batch_size, args.seq_len, seed=args.seed)
+    if start_step:
+        batches.skip(start_step)  # continue the data order, no replay
 
     def dispatch_p50() -> float | None:
         times = list(model.moes[0].dispatch_times)
@@ -294,13 +321,20 @@ def run_swarm(args):
                 p50 = dispatch_p50()
                 entry["dispatch_p50_ms"] = round(p50, 2) if p50 else None
                 print(json.dumps(entry), flush=True)
+                if (
+                    ckpt is not None and args.checkpoint_every
+                    and entry["step"] % args.checkpoint_every == 0
+                ):
+                    # consistent triple under the trainer's apply lock
+                    p, o, done = trainer.snapshot()
+                    ckpt.save((start_step or 0) + done, p, o)
 
             arrayified = (
                 (jnp.asarray(ids), jnp.asarray(tgt)) for ids, tgt in batches
             )
             summary = trainer.train(
-                arrayified, steps=args.steps, log_every=args.log_every,
-                on_log=on_log,
+                arrayified, steps=args.steps - (start_step or 0),
+                log_every=args.log_every, on_log=on_log,
                 tokens_per_batch=args.batch_size * args.seq_len,
             )
             params, opt_state = trainer.params, trainer.opt_state
@@ -313,13 +347,23 @@ def run_swarm(args):
             }), flush=True)
         else:
             t0 = time.perf_counter()
-            for step, (ids, tgt) in zip(range(args.steps), batches):
+            for step, (ids, tgt) in zip(
+                range(start_step or 0, args.steps), batches
+            ):
                 params, opt_state, loss = step_fn(
                     params, opt_state, jnp.asarray(ids), jnp.asarray(tgt)
                 )
+                if (
+                    ckpt is not None and args.checkpoint_every
+                    and (step + 1) % args.checkpoint_every == 0
+                ):
+                    ckpt.save(step + 1, params, opt_state)
                 if step % args.log_every == 0 or step == args.steps - 1:
                     elapsed = time.perf_counter() - t0
-                    tps = (step + 1) * args.batch_size * args.seq_len / elapsed
+                    tps = (
+                        (step + 1 - (start_step or 0))
+                        * args.batch_size * args.seq_len / elapsed
+                    )
                     p50 = dispatch_p50()
                     print(
                         json.dumps(
@@ -341,6 +385,9 @@ def run_swarm(args):
                         ),
                         flush=True,
                     )
+        if ckpt is not None:
+            ckpt.save(args.steps, params, opt_state)
+            print(f"# checkpointed trainer at step {args.steps}", flush=True)
     finally:
         for server in servers:
             server.shutdown()

@@ -118,6 +118,13 @@ class PipelinedSwarmTrainer:
 
     # ---- public API ----
 
+    def snapshot(self) -> tuple:
+        """A CONSISTENT (params, opt_state, step_count) triple — the three
+        are only mutated together under the apply lock, so checkpointing
+        callers must read them under it too."""
+        with self._apply_lock:
+            return self.params, self.opt_state, self.step_count
+
     def train(
         self,
         batches: Iterable,
