@@ -58,6 +58,9 @@ class DMoETransformerConfig:
     # mesh's 'seq' axis (parallel/ring_attention.py).  The MoE stays
     # data+expert sharded; XLA inserts the reshard at the boundary.
     seq_parallel: bool = False
+    # "zigzag" balances causal work across the ring (~2× fewer attention
+    # FLOPs at scale); "contiguous" is the plain ring
+    seq_layout: str = "zigzag"
 
 
 class DMoETransformerLM:
@@ -76,14 +79,36 @@ class DMoETransformerLM:
             param_dtype=config.param_dtype,
         )
         self._ring = None
+        self._zig = self._zig_inv = None
         if config.seq_parallel:
             if "seq" not in mesh.axis_names:
                 raise ValueError("seq_parallel=True requires a 'seq' mesh axis")
             from learning_at_home_tpu.parallel.ring_attention import (
                 make_ring_attention,
+                zigzag_indices,
             )
 
-            self._ring = make_ring_attention(mesh, causal=True)
+            layout = config.seq_layout
+            n_seq = mesh.shape["seq"]
+            if layout == "zigzag" and config.seq_len % (2 * n_seq):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "seq_len %d not divisible by 2*%d — falling back to the "
+                    "contiguous ring layout (zigzag needs paired chunks)",
+                    config.seq_len, n_seq,
+                )
+                layout = "contiguous"
+            if layout == "zigzag":
+                # the residual stream is permuted ONCE at the model
+                # boundary (see apply); the ring consumes zigzag order
+                # directly — 2 gathers per step instead of 4 per layer
+                self._zig = zigzag_indices(config.seq_len, n_seq)
+                self._zig_inv = np.argsort(self._zig)
+            self._ring = make_ring_attention(
+                mesh, causal=True, layout=layout,
+                pre_permuted=self._zig is not None,
+            )
 
     # ---- parameters ----
 
@@ -172,9 +197,22 @@ class DMoETransformerLM:
             x, aux = layer_fn(lp, x)
             return x, aux
 
+        if self._zig is not None:
+            if token_ids.shape[1] != len(self._zig):
+                raise ValueError(
+                    f"zigzag layout was built for seq_len {len(self._zig)}, "
+                    f"got {token_ids.shape[1]} — the pre-permuted ring would "
+                    "silently misattend on other lengths"
+                )
+            # zigzag sequence layout for the whole layer stack: attention
+            # consumes it natively; MoE and norms are per-token (order-
+            # independent); positions were already added above
+            x = x[:, self._zig]
         # scan over the stacked layer params: ONE compiled layer body
         x, aux_stack = jax.lax.scan(body, x, params["layers"])
         aux_total = {k: jnp.sum(v) for k, v in aux_stack.items()}
+        if self._zig is not None:
+            x = x[:, self._zig_inv]
         x = layer_norm(params["ln_f"], x)
         head = (
             params["embed"].T if cfg.tie_embeddings else params["lm_head"]

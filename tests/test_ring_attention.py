@@ -123,3 +123,53 @@ def test_ring_validation_errors():
     k2 = jnp.ones((1, 24, 2, 8))
     with pytest.raises(ValueError, match="must match"):
         ring(q2, k2, k2)
+
+
+@pytest.mark.parametrize("n_seq", [1, 2, 4, 8])
+def test_zigzag_matches_dense(n_seq):
+    """Zigzag layout: exact same math as dense causal attention, at every
+    ring size (n=1..8 chunk-pair layouts hit all mask branches incl.
+    the degenerate single-device diag-only ring)."""
+    mesh = make_mesh({"seq": n_seq}, devices=jax.devices()[:n_seq])
+    rs = np.random.RandomState(2)
+    B, S, H, HD = 2, 16 * n_seq, 3, 8
+    q = rs.randn(B, S, H, HD).astype(np.float32)
+    k = rs.randn(B, S, H, HD).astype(np.float32)
+    v = rs.randn(B, S, H, HD).astype(np.float32)
+    ring = make_ring_attention(mesh, causal=True, layout="zigzag")
+    out = jax.jit(ring)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    expected = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_matches_contiguous_and_grads():
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    rs = np.random.RandomState(3)
+    B, S, H, HD = 1, 64, 2, 4
+    q = jnp.asarray(rs.randn(B, S, H, HD).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, S, H, HD).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, S, H, HD).astype(np.float32))
+    zig = make_ring_attention(mesh, causal=True, layout="zigzag")
+    cont = make_ring_attention(mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(zig)(q, k, v)),
+        np.asarray(jax.jit(cont)(q, k, v)),
+        atol=2e-5, rtol=2e-5,
+    )
+
+    def loss(fn, q, k, v):
+        return (fn(q, k, v) ** 2).sum()
+
+    gz = jax.jit(jax.grad(lambda q: loss(zig, q, k, v)))(q)
+    gc = jax.jit(jax.grad(lambda q: loss(cont, q, k, v)))(q)
+    np.testing.assert_allclose(np.asarray(gz), np.asarray(gc), atol=5e-5, rtol=5e-5)
+
+
+def test_zigzag_rejects_bad_config():
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="CAUSAL"):
+        make_ring_attention(mesh, causal=False, layout="zigzag")
+    ring = make_ring_attention(mesh, causal=True, layout="zigzag")
+    bad = jnp.zeros((1, 20, 2, 4))  # 20 not divisible by 2*4... by shards
+    with pytest.raises(ValueError):
+        jax.jit(ring)(bad, bad, bad)
