@@ -30,7 +30,10 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Prior-round bests to compute vs_baseline against (BASELINE.md).
-BASELINE_TPS = {"cpu": 190.0}  # round-1 CPU fallback, shrunk config
+BASELINE_TPS = {
+    "cpu": 190.0,  # round-1 CPU fallback, shrunk config
+    "tpu": 656008.0,  # round-2 first real-chip number (v5e, 256 experts)
+}
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
 TPU_PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 

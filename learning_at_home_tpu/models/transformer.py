@@ -245,7 +245,7 @@ class DMoETransformerLM:
 
         abstract = jax.eval_shape(optimizer.init, params)
         shardings = opt_state_shardings(
-            abstract, self.param_shardings(params), self.mesh
+            abstract, self.param_shardings(params), params, self.mesh
         )
         return jax.jit(optimizer.init, out_shardings=shardings)(params)
 

@@ -60,25 +60,45 @@ def expert_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("expert"))
 
 
-def opt_state_shardings(abstract_opt_state, param_shardings, mesh: Mesh):
+def opt_state_shardings(abstract_opt_state, param_shardings, params, mesh: Mesh):
     """Shardings for an optimizer state mirroring the param tree.
 
     Optimizer states (optax) embed sub-trees shaped like the params (mu/nu
     in Adam); those leaves inherit the matching param's sharding — found by
-    matching each opt-state leaf's key-path SUFFIX against param key-paths.
+    matching each opt-state leaf's key-path SUFFIX against param key-paths
+    AND requiring the leaf's shape to equal the param's shape.  The shape
+    check matters for factored optimizers (adafactor): its ``v_row/v_col/v``
+    sub-trees reuse the param key paths but hold reduced-rank statistics,
+    which must be replicated, not given the param's (higher-rank) spec.
     Everything else (step counts, scalars) is replicated.  Needed because
     ``jit(opt.init)`` does not propagate NamedShardings to its outputs, and
     a checkpoint restored onto mismatched devices poisons the train step.
     """
-    flat_params = jax.tree_util.tree_flatten_with_path(param_shardings)[0]
-    param_map = {jax.tree_util.keystr(path): s for path, s in flat_params}
+    shard_map_ = {
+        jax.tree_util.keystr(path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+    }
+    shape_map = {
+        jax.tree_util.keystr(path): tuple(p.shape)
+        for path, p in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    if shard_map_.keys() != shape_map.keys():
+        raise ValueError(
+            "param_shardings and params trees disagree: "
+            f"{sorted(shard_map_.keys() ^ shape_map.keys())[:4]} — a silent "
+            "mispairing here would mis-shard the optimizer state"
+        )
+    param_map = {k: (shard_map_[k], shape_map[k]) for k in shard_map_}
     repl = NamedSharding(mesh, P())
 
     def assign(path, leaf):
         for i in range(len(path)):
             suffix = jax.tree_util.keystr(path[i:])
             if suffix in param_map:
-                return param_map[suffix]
+                sharding, shape = param_map[suffix]
+                if tuple(leaf.shape) == shape:
+                    return sharding
+                return repl
         return repl
 
     return jax.tree_util.tree_map_with_path(assign, abstract_opt_state)

@@ -252,6 +252,13 @@ def make_ring_attention(
                 f"sequence length {q.shape[1]} must divide across the "
                 f"{n_shards} shards of mesh axis {axis_name!r}"
             )
+        if layout == "zigzag" and q.shape[1] % (2 * n_shards):
+            # also guards the pre_permuted path: each shard needs an even
+            # local chunk to split into its lo/hi halves
+            raise ValueError(
+                f"zigzag needs seq_len divisible by 2*{n_shards} shards, "
+                f"got {q.shape[1]}"
+            )
         if layout == "zigzag" and not pre_permuted:
             zig = zigzag_indices(q.shape[1], n_shards)
             inv = np.argsort(zig)
