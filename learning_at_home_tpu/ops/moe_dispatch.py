@@ -53,6 +53,23 @@ def compute_capacity(
     return max(1, math.ceil(n_tokens * k * capacity_factor / n_experts))
 
 
+def choose_dispatch_impl(n_tokens: int, n_slots: int) -> str:
+    """Static (trace-time) choice between the two dispatch implementations.
+
+    Measured on a real TPU v5e with fetch-forced timing (BASELINE.md
+    round-2 "TPU dispatch profile" row — the authoritative numbers): the
+    one-hot einsum (O(n·slots·d) MXU FLOPs) beats the row gather
+    (O(slots·d) random-row HBM traffic) when the token×slot product is
+    small — 881 vs 1539 µs at n=4096/slots=10240/d=512 — and loses when
+    it is large — 2863 vs 1634 µs at n=8192/slots=20480/d=1024 and
+    4513 vs 1673 µs at n=16384/slots=40960/d=512.  Equating the two cost
+    models (MXU FLOP rate vs effective random-row bandwidth; d and dtype
+    cancel) puts the crossover at a harmonic mean n·slots/(n+slots)
+    ≈ 4000, which classifies all three measured points correctly."""
+    harmonic = n_tokens * n_slots / (n_tokens + n_slots)
+    return "onehot" if harmonic < 4000 else "gather"
+
+
 def _expert_positions(top_i: jax.Array, num_experts: int) -> jax.Array:
     """Slot position of each (token, choice) within its chosen expert.
 

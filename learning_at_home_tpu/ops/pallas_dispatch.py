@@ -35,26 +35,57 @@ from learning_at_home_tpu.ops.moe_dispatch import (
 )
 
 
-def _dispatch_kernel(idx_ref, x_hbm_ref, out_ref, row_vmem, dma_sem):
-    """One program per expert slot: DMA its source token's row (or zeros)."""
+# Slots per grid step.  The TPU lowering requires the output block's
+# sublane dim divisible by 8; batching 8 row-DMAs per step also lets them
+# overlap in flight before the single blocked VMEM→HBM write.
+_SLOT_BLOCK = 8
+
+
+def _dispatch_kernel(idx_ref, x_hbm_ref, out_ref, chunks_vmem, sems):
+    """One program per _SLOT_BLOCK expert slots.
+
+    Mosaic forbids single-row (1, d) slices of a (8, 128)-tiled HBM
+    memref and sub-1024-element slices of 1-D VMEM, so a row-exact DMA is
+    unimplementable; instead each slot DMAs the 8-row ALIGNED chunk
+    containing its token (8× read amplification — the price of the tiling
+    rule) and selects the row in VMEM with a masked sum over the sublane
+    axis (dynamic sublane indexing is also restricted).  All DMAs start
+    before any wait, so the 8 chunk fetches overlap in flight."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    slot = pl.program_id(0)
-    token = idx_ref[slot]
+    base = pl.program_id(0) * _SLOT_BLOCK
+    for j in range(_SLOT_BLOCK):
+        token = idx_ref[base + j]
 
-    @pl.when(token >= 0)
-    def _copy():
-        dma = pltpu.make_async_copy(
-            x_hbm_ref.at[pl.ds(token, 1), :], row_vmem, dma_sem
-        )
-        dma.start()
-        dma.wait()
-        out_ref[...] = row_vmem[...]
+        @pl.when(token >= 0)
+        def _start(j=j, token=token):
+            chunk = (token // 8) * 8
+            pltpu.make_async_copy(
+                x_hbm_ref.at[pl.ds(chunk, 8), :],
+                chunks_vmem.at[j],
+                sems.at[j],
+            ).start()
 
-    @pl.when(token < 0)
-    def _zero():
-        out_ref[...] = jnp.zeros_like(out_ref)
+    for j in range(_SLOT_BLOCK):
+        token = idx_ref[base + j]
+
+        @pl.when(token >= 0)
+        def _select(j=j, token=token):
+            chunk = (token // 8) * 8
+            pltpu.make_async_copy(
+                x_hbm_ref.at[pl.ds(chunk, 8), :],
+                chunks_vmem.at[j],
+                sems.at[j],
+            ).wait()
+            rows = chunks_vmem[j]  # (8, d)
+            sub = jax.lax.broadcasted_iota(jnp.int32, rows.shape, 0)
+            mask = (sub == token % 8).astype(rows.dtype)
+            out_ref[j, :] = jnp.sum(rows * mask, axis=0)
+
+        @pl.when(token < 0)
+        def _zero(j=j):
+            out_ref[j, :] = jnp.zeros((out_ref.shape[-1],), out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -73,21 +104,28 @@ def dispatch_tokens_pallas(
     n, d = x.shape
     if d % 128:
         raise ValueError(f"pallas dispatch needs d % 128 == 0, got d={d}")
+    slots = num_experts * capacity
+    if slots % _SLOT_BLOCK:
+        raise ValueError(
+            f"pallas dispatch needs E*C % {_SLOT_BLOCK} == 0, got {slots}"
+        )
+    if n % 8:
+        raise ValueError(f"pallas dispatch needs n % 8 == 0, got n={n}")
     flat_idx = plan.token_for_slot.reshape(-1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # the slot→token index array
-        grid=(num_experts * capacity,),
+        grid=(slots // _SLOT_BLOCK,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # x stays in HBM
-        out_specs=pl.BlockSpec((1, d), lambda i, idx_ref: (i, 0)),
+        out_specs=pl.BlockSpec((_SLOT_BLOCK, d), lambda i, idx_ref: (i, 0)),
         scratch_shapes=[
-            pltpu.VMEM((1, d), x.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((_SLOT_BLOCK, 8, d), x.dtype),
+            pltpu.SemaphoreType.DMA((_SLOT_BLOCK,)),
         ],
     )
     out = pl.pallas_call(
         _dispatch_kernel,
-        out_shape=jax.ShapeDtypeStruct((num_experts * capacity, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((slots, d), x.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )(flat_idx, x)
@@ -102,6 +140,12 @@ def dispatch_tokens_auto(
 ) -> jax.Array:
     """Dispatch with graceful fallback: the Pallas kernel when requested AND
     its constraints hold, otherwise the XLA gather."""
-    if use_pallas and x.shape[-1] % 128 == 0:
+    slots = plan.token_for_slot.shape[0] * plan.token_for_slot.shape[1]
+    if (
+        use_pallas
+        and x.shape[-1] % 128 == 0
+        and x.shape[0] % 8 == 0
+        and slots % _SLOT_BLOCK == 0
+    ):
         return dispatch_tokens_pallas(x, plan, interpret=interpret)
     return dispatch_tokens_indexed(x, plan)

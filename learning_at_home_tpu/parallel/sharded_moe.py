@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from learning_at_home_tpu.ops.moe_dispatch import (
+    choose_dispatch_impl,
     combine_outputs,
     combine_outputs_indexed,
     compute_capacity,
@@ -67,11 +68,12 @@ class ShardedMixtureOfExperts:
         ffn_mult: int = 4,
         dtype: Any = jnp.bfloat16,
         param_dtype: Any = jnp.float32,
-        dispatch_impl: str = "gather",
+        dispatch_impl: str = "auto",
     ):
-        if dispatch_impl not in ("gather", "onehot"):
+        if dispatch_impl not in ("auto", "gather", "onehot"):
             raise ValueError(
-                f"dispatch_impl must be 'gather' or 'onehot', got {dispatch_impl!r}"
+                "dispatch_impl must be 'auto', 'gather' or 'onehot', "
+                f"got {dispatch_impl!r}"
             )
         if "expert" not in mesh.axis_names:
             raise ValueError("mesh must have an 'expert' axis")
@@ -100,7 +102,8 @@ class ShardedMixtureOfExperts:
         self.param_dtype = param_dtype
         # 'gather' moves tokens with index gathers/scatters (O(E*C*d) data
         # movement); 'onehot' uses the GShard-style [n,E,C] einsums
-        # (O(n*E*C*d) MXU work) — kept for comparison/fallback.
+        # (O(n*E*C*d) MXU work); 'auto' picks per static shape via
+        # ops.moe_dispatch.choose_dispatch_impl (v5e-measured crossover).
         self.dispatch_impl = dispatch_impl
         self._shard = data_axes(mesh)  # axes the token batch is split over
 
@@ -188,11 +191,17 @@ class ShardedMixtureOfExperts:
         d = self.hidden_dim
         compute = self.dtype
 
+        impl = self.dispatch_impl
+        if impl == "auto":
+            impl = choose_dispatch_impl(
+                x.shape[0], self.num_experts * capacity
+            )
+
         # 1) gate + routing plan for MY tokens (logits in f32 for stable softmax)
         logits = (x.astype(compute) @ params["gate"].astype(compute)).astype(
             jnp.float32
         )
-        if self.dispatch_impl == "gather":
+        if impl == "gather":
             plan = top_k_gating_indices(logits, self.k, capacity)
             x_send = dispatch_tokens_indexed(x.astype(compute), plan)
         else:
@@ -225,7 +234,7 @@ class ShardedMixtureOfExperts:
         ).reshape(self.num_experts, capacity, d)
 
         # 5) gate-weighted combine for MY tokens
-        if self.dispatch_impl == "gather":
+        if impl == "gather":
             y = combine_outputs_indexed(y_recv, plan).astype(x.dtype)
         else:
             y = combine_outputs(y_recv, plan).astype(x.dtype)
