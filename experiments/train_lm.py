@@ -56,6 +56,27 @@ def parse_args():
                         "latency (s) on every server reply")
     p.add_argument("--k", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument(
+        "--optimizer", choices=("adamw", "adafactor"), default="adamw",
+        help="pod mode: adafactor (factored, ~no state) fits the "
+        "256-expert shape on one 16 GB chip where f32+AdamW cannot",
+    )
+    p.add_argument(
+        "--param-dtype", choices=("f32", "bf16"), default="f32",
+        help="pod mode: parameter storage dtype (bf16 halves HBM)",
+    )
+    p.add_argument(
+        "--router-jitter", type=float, default=0.0,
+        help="pod mode: multiplicative routing noise, selection-only "
+        "(0 = off, matching DMoETransformerConfig and preserving zigzag/"
+        "contiguous equivalence).  Byte-level batches hold ~84 unique "
+        "tokens and collapse onto few experts at init; 0.1 with "
+        "--aux-weight 5e-2 is the measured recipe (BASELINE.md)",
+    )
+    p.add_argument(
+        "--aux-weight", type=float, default=1e-2,
+        help="pod mode: load-balance auxiliary loss weight",
+    )
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
                    help="trainer-side checkpoints (pod and swarm modes)")
@@ -90,6 +111,9 @@ def run_pod(args):
         num_experts=args.num_experts,
         k=args.k,
         dtype=jnp.bfloat16 if jax.devices()[0].platform != "cpu" else jnp.float32,
+        param_dtype=jnp.bfloat16 if args.param_dtype == "bf16" else jnp.float32,
+        router_jitter=args.router_jitter,
+        aux_loss_weight=args.aux_weight,
     )
     from learning_at_home_tpu.parallel.mesh import data_axes
 
@@ -101,7 +125,13 @@ def run_pod(args):
         )
     model = DMoETransformerLM(cfg, mesh)
     params = model.init_params(jax.random.PRNGKey(args.seed))
-    optimizer = optax.adamw(args.lr)
+    # adafactor + bf16 params is the single-chip recipe for the 256-expert
+    # shape (f32+AdamW needs ~34 GB of state vs one v5e's 16 GB HBM)
+    optimizer = (
+        optax.adafactor(args.lr)
+        if args.optimizer == "adafactor"
+        else optax.adamw(args.lr)
+    )
     opt_state = model.init_opt_state(optimizer, params)
     step_fn = model.make_train_step(optimizer)
 

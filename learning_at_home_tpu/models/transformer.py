@@ -50,6 +50,15 @@ class DMoETransformerConfig:
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
     router_z_weight: float = 1e-3  # ST-MoE router z-loss
+    # Switch-style multiplicative routing noise (deterministic pattern;
+    # see ops.moe_dispatch.router_jitter).  Essential for byte-level
+    # corpora where near-identical rows otherwise collapse onto the same
+    # experts (measured 0.73 init dropped fraction on the 256-expert
+    # flagship).  Default OFF: the fixed row↦noise map is not
+    # permutation-invariant, so it would break exact zigzag/contiguous
+    # sequence-layout equivalence; trainers opt in (train_lm
+    # --router-jitter).
+    router_jitter: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
@@ -80,6 +89,7 @@ class DMoETransformerLM:
             capacity_factor=config.capacity_factor,
             dtype=config.dtype,
             param_dtype=config.param_dtype,
+            router_jitter=config.router_jitter,
         )
         self._ring = None
         self._zig = self._zig_inv = None
@@ -223,17 +233,26 @@ class DMoETransformerLM:
         return x, aux_mean
 
     def _head(self, params: Params) -> jax.Array:
+        # compute dtype (bf16 on TPU), NOT f32: the MXU runs bf16 operands
+        # at full rate with f32 accumulation (preferred_element_type at
+        # the logits matmul); an f32 operand forces the slow multi-pass
+        # path — measured as the dominant cost of the chunked CE.
         return (
             params["embed"].T
             if self.cfg.tie_embeddings
             else params["lm_head"]
-        ).astype(jnp.float32)
+        ).astype(self.cfg.dtype)
+
+    @staticmethod
+    def _logits(x: jax.Array, head: jax.Array) -> jax.Array:
+        return jnp.einsum(
+            "...d,dv->...v", x, head, preferred_element_type=jnp.float32
+        )
 
     def apply(self, params: Params, token_ids: jax.Array) -> tuple[jax.Array, dict]:
-        """token_ids [B, S] → logits [B, S, V]; aux dict of scalars."""
+        """token_ids [B, S] → logits [B, S, V] (f32); aux dict of scalars."""
         x, aux_mean = self._hidden(params, token_ids)
-        logits = x.astype(jnp.float32) @ self._head(params)
-        return logits, aux_mean
+        return self._logits(x, self._head(params)), aux_mean
 
     # ---- loss / train step ----
 
@@ -257,7 +276,7 @@ class DMoETransformerLM:
 
         def chunk_ce(carry, xt):
             xc, tc = xt
-            logits = xc.astype(jnp.float32) @ head
+            logits = self._logits(xc, head)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
             return carry + ce.sum(), None
 
@@ -302,21 +321,62 @@ class DMoETransformerLM:
         return jax.jit(optimizer.init, out_shardings=shardings)(params)
 
     def make_train_step(
-        self, optimizer: optax.GradientTransformation
+        self, optimizer: optax.GradientTransformation, accum_steps: int = 1
     ) -> Callable:
-        """Donating, fully-jitted train step; inputs sharded over the mesh."""
+        """Donating, fully-jitted train step; inputs sharded over the mesh.
+
+        ``accum_steps > 1`` returns a step that takes token_ids/targets of
+        shape [accum, batch, seq], runs the microbatches sequentially
+        through one ``lax.scan`` (sequential execution is what bounds
+        live activations to one microbatch — grad_fn is already the
+        differentiated function, so no checkpoint wrapper applies),
+        averages the gradients, and applies ONE optimizer update —
+        effective batch = accum × batch without the activation HBM of
+        the large batch."""
+        grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
 
         def train_step(params, opt_state, token_ids, targets):
-            (loss, metrics), grads = jax.value_and_grad(
-                self.loss_fn, has_aux=True
-            )(params, token_ids, targets)
+            (loss, metrics), grads = grad_fn(params, token_ids, targets)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, metrics
 
+        def accum_step(params, opt_state, token_ids, targets):
+            def micro(carry, xt):
+                gsum, lsum, msum = carry
+                ids, tgt = xt
+                (loss, metrics), grads = grad_fn(params, ids, tgt)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                msum = jax.tree_util.tree_map(jnp.add, msum, metrics)
+                return (gsum, lsum + loss, msum), None
+
+            zeros_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+            zeros_m = jax.eval_shape(
+                lambda p: grad_fn(p, token_ids[0], targets[0])[0][1], params
+            )
+            zeros_m = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, l.dtype), zeros_m
+            )
+            (gsum, lsum, msum), _ = jax.lax.scan(
+                micro,
+                (zeros_g, jnp.float32(0), zeros_m),
+                (token_ids, targets),
+            )
+            inv = 1.0 / accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics = jax.tree_util.tree_map(lambda m: m * inv, msum)
+            return params, opt_state, lsum * inv, metrics
+
         data_shard = batch_sharding(self.mesh)
+        if accum_steps > 1:
+            # microbatch axis is leading: prepend None to the batch spec
+            data_shard = NamedSharding(
+                self.mesh, P(None, *data_shard.spec)
+            )
         return jax.jit(
-            train_step,
+            accum_step if accum_steps > 1 else train_step,
             in_shardings=(None, None, data_shard, data_shard),
             donate_argnums=(0, 1),
         )

@@ -136,3 +136,42 @@ def test_gating_is_differentiable():
     g = jax.grad(loss)(w)
     assert np.isfinite(np.asarray(g)).all()
     assert float(jnp.abs(g).sum()) > 0
+
+
+def test_router_jitter_selection_only():
+    """Jitter may change WHICH experts are picked, but the combine weights
+    must always be the clean (unjittered) gate values at the selected
+    indices — the fixed noise pattern must never bias the output mixture.
+    And duplicate rows must stop routing identically."""
+    from learning_at_home_tpu.ops.moe_dispatch import top_k_gating_indices
+
+    rs = np.random.RandomState(0)
+    # 64 IDENTICAL rows: without jitter they all pick the same experts
+    row = rs.randn(1, 16).astype(np.float32) * 0.01
+    logits = jnp.asarray(np.repeat(row, 64, axis=0))
+    plan_clean = top_k_gating_indices(logits, 2, 8)
+    plan_jit = top_k_gating_indices(logits, 2, 8, jitter=0.5)
+    # clean: identical rows route identically -> heavy capacity dropping
+    assert float(plan_clean.dropped_fraction) > 0.5
+    # jittered: selection decorrelates, drop falls sharply
+    assert float(plan_jit.dropped_fraction) < ONE_THIRD * float(
+        plan_clean.dropped_fraction
+    ) + 0.2
+    # weights are renormalized CLEAN gate values at the selected experts
+    gates = jax.nn.softmax(logits, axis=-1)
+    slot = np.asarray(plan_jit.slot_for_token)  # [n, k] flat slots
+    w = np.asarray(plan_jit.weights)
+    expert_of_slot = slot // 8
+    checked = 0
+    for i in range(64):
+        if (slot[i] < 0).any():
+            continue  # dropped choices hide the selected expert id
+        sel = expert_of_slot[i]
+        gvals = np.asarray(gates[i])[sel]
+        expect = gvals / gvals.sum()
+        np.testing.assert_allclose(w[i], expect, rtol=1e-5, atol=1e-6)
+        checked += 1
+    assert checked > 0
+
+
+ONE_THIRD = 1.0 / 3.0

@@ -209,6 +209,39 @@ def test_opt_state_shardings_factored_optimizer():
     assert np.isfinite(float(loss))
 
 
+def test_grad_accumulation_matches_mean_of_micro_grads():
+    """accum_steps=2 must equal hand-averaged per-microbatch grads fed to
+    one optimizer update (same capacity per microbatch, so exact match)."""
+    mesh = make_mesh({"data": 2, "expert": 4})
+    model, cfg = _tiny_model(mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+    opt = optax.sgd(1e-2)
+    opt_state = model.init_opt_state(opt, params)
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(0, 64, (2, 8, 16)))
+    tgt = jnp.asarray(rs.randint(0, 64, (2, 8, 16)))
+
+    # reference: average grads of the two microbatches, one update
+    gfn = jax.grad(lambda p, i, t: model.loss_fn(p, i, t)[0])
+    g0 = gfn(params, ids[0], tgt[0])
+    g1 = gfn(params, ids[1], tgt[1])
+    gavg = jax.tree_util.tree_map(lambda a, b: (a + b) / 2, g0, g1)
+    upd, _ = opt.update(gavg, opt_state, params)
+    ref = optax.apply_updates(params, upd)
+
+    step = model.make_train_step(opt, accum_steps=2)
+    got, _, loss, metrics = step(params, opt_state, ids, tgt)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(got)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-5, atol=2e-6,
+        )
+    assert np.isfinite(float(loss))
+    assert 0.0 <= float(metrics["dropped_fraction"]) <= 1.0
+
+
 def test_chunked_ce_matches_full_logits():
     """loss_fn's rematerialized CE must equal the full-logits loss for
     divisible AND indivisible token counts (the indivisible remainder
