@@ -32,10 +32,11 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # Prior-round bests to compute vs_baseline against (BASELINE.md).
 BASELINE_TPS = {
     "cpu": 190.0,  # round-1 CPU fallback, shrunk config
-    # Round-2 honest real-chip number (v5e, 256 experts, batch 56,
-    # fetch-forced timing — block_until_ready does NOT block through the
-    # axon tunnel; earlier 656k/1.38M figures were timing artifacts).
-    "tpu": 99782.0,
+    # Round-2 best real-chip number (v5e, 256 experts, batch 176 +
+    # remat, fetch-forced timing — block_until_ready does NOT block
+    # through the axon tunnel; see BASELINE.md for the progression
+    # 32.3k → 99.8k → 152.3k tok/s within round 2).
+    "tpu": 152342.0,
 }
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
 TPU_PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
@@ -193,12 +194,24 @@ def _activation_bytes(cfg, batch: int) -> int:
     cap = int(np.ceil(cfg.capacity_factor * cfg.k * tokens / E))
     act_dtype = jnp.dtype(cfg.dtype).itemsize
     ce_chunk = min(getattr(cfg, "ce_chunk", tokens), tokens)
+    if getattr(cfg, "remat", False):
+        # checkpointed layers save only their INPUT; internals (attn
+        # saves, dispatch buffers, router scores) live for one layer at
+        # a time during the recomputing backward
+        per_layer = tokens * d * act_dtype * 2 * L
+        live = (
+            tokens * d * act_dtype * 10
+            + E * cap * d * act_dtype * 4
+            + tokens * E * 4 * 2
+        )
+    else:
+        per_layer = tokens * d * act_dtype * 10 * L
+        live = E * cap * d * act_dtype * 4 * L + tokens * E * 4 * 2
     return (
         ce_chunk * v * 4 * 3  # f32 logits+grads+temps, ONE CE chunk at a time
         + tokens * d * act_dtype * 2  # saved final hidden + its cotangent
-        + tokens * d * act_dtype * 10 * L  # residual stream + attn saves
-        + E * cap * d * act_dtype * 4 * L  # dispatch/return buffers
-        + tokens * E * 4 * 2  # router scores + top-k sort temps (f32)
+        + per_layer
+        + live
     )
 
 
@@ -231,7 +244,11 @@ def worker() -> None:
         # 16 GB v5e — so the single-chip bench stores params in bf16
         # with a factored optimizer (Adafactor, no first moment); the
         # pod deployment shards f32+AdamW state over the mesh instead.
-        cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16)
+        # remat=True: recomputing layer internals in backward frees
+        # enough activation HBM to triple the batch — measured (v5e,
+        # 2026-07-29): no-remat peaks at 99.8k tok/s (batch 56); remat
+        # 112→127k, 144→140k, 176→150k, 208→150k (plateau).
+        cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16, remat=True)
         model = DMoETransformerLM(cfg, mesh)
     else:  # local smoke only: shrink to something a 1-core CPU can turn
         cfg = dataclasses.replace(cfg, num_experts=8, dtype=jnp.float32)
@@ -257,16 +274,15 @@ def worker() -> None:
     if os.environ.get("BENCH_BATCH"):
         batch = int(os.environ["BENCH_BATCH"])
     elif on_tpu:
-        # Candidates capped at 56: measured on the v5e (2026-07-29),
-        # batch 64 passes the analytic filter (est 10.5 GB) but collapses
-        # to 845 ms/step (vs 144 at batch 56 / 118 at 32) — the allocator
-        # thrashes near capacity in ways the closed-form model can't see.
-        # Sweep: 16→32.3k, 32→69.6k, 48→88.9k, 56→99.8k, 60→101.9k,
-        # 64→19.4k tok/s.  60 is deliberately excluded: +2% over 56 but
-        # only one bucket from the cliff, and allocator state near the
-        # edge varies run to run — the graded bench favors the margin.
+        # Candidates are measured, not purely analytic: the allocator
+        # thrashes near capacity in ways the closed-form model can't see
+        # (no-remat batch 64 passed the 10.5 GB estimate yet ran 845
+        # ms/step).  With remat the sweep plateaus at ~150k tok/s by
+        # batch 176 (208 is equal within noise) — 176 keeps margin from
+        # any unprobed cliff.  Non-remat sweep for reference: 56→99.8k,
+        # 60→101.9k, 64→19.4k (cliff).
         batch = next(
-            (b for b in (56, 48, 32, 16, 8, 4)
+            (b for b in (176, 144, 112, 56, 32, 16, 8, 4)
              if static_b + _activation_bytes(cfg, b) <= budget),
             None,
         )
