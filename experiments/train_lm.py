@@ -77,6 +77,12 @@ def parse_args():
         "--aux-weight", type=float, default=1e-2,
         help="pod mode: load-balance auxiliary loss weight",
     )
+    p.add_argument(
+        "--gating", choices=("topk", "expert_choice"), default="topk",
+        help="pod mode: token-choice top-k (capacity drops) or "
+        "expert-choice (each expert picks top-C tokens; balanced by "
+        "construction, no jitter/aux needed)",
+    )
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
                    help="trainer-side checkpoints (pod and swarm modes)")
@@ -114,6 +120,7 @@ def run_pod(args):
         param_dtype=jnp.bfloat16 if args.param_dtype == "bf16" else jnp.float32,
         router_jitter=args.router_jitter,
         aux_loss_weight=args.aux_weight,
+        gating=args.gating,
     )
     from learning_at_home_tpu.parallel.mesh import data_axes
 

@@ -59,6 +59,10 @@ class DMoETransformerConfig:
     # sequence-layout equivalence; trainers opt in (train_lm
     # --router-jitter).
     router_jitter: float = 0.0
+    # 'topk' (token-choice, capacity drops) or 'expert_choice' (each
+    # expert picks top-C tokens; perfectly balanced, no aux loss; routing
+    # depends on the batch — see ops.moe_dispatch.expert_choice_gating)
+    gating: str = "topk"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
@@ -90,6 +94,7 @@ class DMoETransformerLM:
             dtype=config.dtype,
             param_dtype=config.param_dtype,
             router_jitter=config.router_jitter,
+            gating=config.gating,
         )
         self._ring = None
         self._zig = self._zig_inv = None

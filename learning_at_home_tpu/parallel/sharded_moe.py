@@ -35,8 +35,11 @@ from jax import shard_map
 from learning_at_home_tpu.ops.moe_dispatch import (
     choose_dispatch_impl,
     combine_outputs,
+    combine_outputs_expert_choice,
     combine_outputs_indexed,
     compute_capacity,
+    dispatch_tokens_expert_choice,
+    expert_choice_gating,
     dispatch_tokens,
     dispatch_tokens_indexed,
     top_k_gating,
@@ -70,11 +73,23 @@ class ShardedMixtureOfExperts:
         param_dtype: Any = jnp.float32,
         dispatch_impl: str = "auto",
         router_jitter: float = 0.0,
+        gating: str = "topk",
     ):
         if dispatch_impl not in ("auto", "gather", "onehot"):
             raise ValueError(
                 "dispatch_impl must be 'auto', 'gather' or 'onehot', "
                 f"got {dispatch_impl!r}"
+            )
+        if gating not in ("topk", "expert_choice"):
+            raise ValueError(
+                f"gating must be 'topk' or 'expert_choice', got {gating!r}"
+            )
+        if gating == "expert_choice" and router_jitter:
+            raise ValueError(
+                "router_jitter applies only to token-choice top-k gating; "
+                "expert_choice is balanced by construction — pass "
+                "router_jitter=0 (a silently ignored setting would make "
+                "recipe comparisons lie)"
             )
         if "expert" not in mesh.axis_names:
             raise ValueError("mesh must have an 'expert' axis")
@@ -110,6 +125,11 @@ class ShardedMixtureOfExperts:
         # ops.moe_dispatch.router_jitter) — breaks routing collapse when
         # many rows are near-identical (byte-level data near init)
         self.router_jitter = router_jitter
+        # 'topk' = token-choice with capacity dropping; 'expert_choice' =
+        # each expert picks its top-C tokens (perfectly balanced, no aux
+        # loss, no capacity drops; routing is batch-dependent — see
+        # ops.moe_dispatch.expert_choice_gating for the causality note)
+        self.gating = gating
         self._shard = data_axes(mesh)  # axes the token batch is split over
 
     # ---- parameters ----
@@ -211,7 +231,10 @@ class ShardedMixtureOfExperts:
         logits = (x.astype(compute) @ params["gate"].astype(compute)).astype(
             jnp.float32
         )
-        if impl == "gather":
+        if self.gating == "expert_choice":
+            plan = expert_choice_gating(logits, capacity)
+            x_send = dispatch_tokens_expert_choice(x.astype(compute), plan)
+        elif impl == "gather":
             plan = top_k_gating_indices(
                 logits, self.k, capacity, jitter=self.router_jitter
             )
@@ -248,7 +271,11 @@ class ShardedMixtureOfExperts:
         ).reshape(self.num_experts, capacity, d)
 
         # 5) gate-weighted combine for MY tokens
-        if impl == "gather":
+        if self.gating == "expert_choice":
+            y = combine_outputs_expert_choice(
+                y_recv, plan, x.shape[0]
+            ).astype(x.dtype)
+        elif impl == "gather":
             y = combine_outputs_indexed(y_recv, plan).astype(x.dtype)
         else:
             y = combine_outputs(y_recv, plan).astype(x.dtype)
@@ -257,9 +284,17 @@ class ShardedMixtureOfExperts:
         # router z-loss (ST-MoE): penalizes logit magnitude so the softmax
         # stays in a well-conditioned regime at scale
         router_z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+        if self.gating == "expert_choice":
+            # perfectly balanced by construction: no balance auxiliary;
+            # "dropped_fraction" reports tokens selected by NO expert
+            aux_loss = jnp.float32(0)
+            dropped = plan.uncovered_fraction
+        else:
+            aux_loss = plan.aux_loss
+            dropped = plan.dropped_fraction
         aux = {
-            "aux_loss": jax.lax.pmean(plan.aux_loss, axes),
+            "aux_loss": jax.lax.pmean(aux_loss, axes),
             "router_z_loss": jax.lax.pmean(router_z, axes),
-            "dropped_fraction": jax.lax.pmean(plan.dropped_fraction, axes),
+            "dropped_fraction": jax.lax.pmean(dropped, axes),
         }
         return y, aux
