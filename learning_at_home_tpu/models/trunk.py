@@ -37,15 +37,42 @@ def output_projection(lp: dict, out: jax.Array) -> jax.Array:
     return out.reshape(b, s, h * hd) @ lp["wo"].astype(out.dtype)
 
 
-def causal_attention(lp: dict, x: jax.Array, n_heads: int) -> jax.Array:
-    """Multi-head causal self-attention via ``jax.nn.dot_product_attention``
-    (f32 softmax, 1/sqrt(hd) scale).  NB: jax 0.9's default implementation
-    still materializes the [B,H,S,S] scores — the API is used so future
-    jax releases/backends can substitute fused kernels, NOT for a memory
-    win today.  For sequences too long for O(S^2) memory use the ring path
+def causal_attention(
+    lp: dict, x: jax.Array, n_heads: int, impl: str = "xla"
+) -> jax.Array:
+    """Multi-head causal self-attention.
+
+    impl="xla": ``jax.nn.dot_product_attention`` (f32 softmax, 1/sqrt(hd)
+    scale).  NB: jax 0.9's default implementation still materializes the
+    [B,H,S,S] scores — the API is used so future jax releases/backends
+    can substitute fused kernels, NOT for a memory win today.
+
+    impl="flash": the TPU Pallas flash-attention kernel
+    (``jax.experimental.pallas.ops.tpu.flash_attention``) — O(S) memory,
+    block-streamed online softmax on the MXU.  TPU-only; sequence length
+    must divide its block size (512 or S, whichever is smaller).
+
+    For sequences split ACROSS chips use the ring path
     (parallel/ring_attention.py), which shares :func:`qkv_projections` /
     :func:`output_projection` and replaces only this dense core.
     """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"impl must be 'xla' or 'flash', got {impl!r}")
     q, k, v = qkv_projections(lp, x, n_heads)
-    out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    if impl == "flash":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        hd = q.shape[-1]
+        # kernel convention is [B, H, S, hd] and applies no scale itself
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=True,
+            sm_scale=1.0 / (hd ** 0.5),
+        ).transpose(0, 2, 1, 3)
+    else:
+        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
     return output_projection(lp, out)

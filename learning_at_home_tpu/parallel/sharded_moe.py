@@ -198,6 +198,11 @@ class ShardedMixtureOfExperts:
         capacity = compute_capacity(
             n_local, self.num_experts, self.k, self.capacity_factor
         )
+        if self.gating == "expert_choice":
+            # expert-choice selects top-C TOKENS per expert, so C can
+            # never exceed the shard's token count; clamping HERE keeps
+            # the all_to_all reshapes consistent with the plan shape
+            capacity = min(capacity, n_local)
 
         fn = shard_map(
             functools.partial(self._local_forward, capacity=capacity),

@@ -384,3 +384,40 @@ def test_expert_choice_rejects_router_jitter():
             mesh, hidden_dim=16, num_experts=8,
             gating="expert_choice", router_jitter=0.1,
         )
+
+
+def test_attn_impl_auto_resolves_to_xla_on_cpu():
+    """'auto' must never pick the TPU-only flash kernel on CPU, and an
+    explicit 'xla' stays untouched."""
+    import dataclasses
+
+    mesh = make_mesh({"expert": 8})
+    _, cfg = _tiny_model(mesh)
+    m = DMoETransformerLM(
+        dataclasses.replace(cfg, attn_impl="auto", seq_len=16), mesh
+    )
+    assert m.cfg.attn_impl == "xla"
+    m2 = DMoETransformerLM(
+        dataclasses.replace(cfg, attn_impl="xla"), mesh
+    )
+    assert m2.cfg.attn_impl == "xla"
+
+
+def test_expert_choice_small_shard_capacity_clamps_through_moe():
+    """capacity > n_local must clamp consistently through the all_to_all
+    reshapes (the direct-op clamp alone left the reshape mismatched)."""
+    from learning_at_home_tpu.parallel.sharded_moe import (
+        ShardedMixtureOfExperts,
+    )
+
+    mesh = make_mesh({"expert": 2}, devices=jax.devices()[:2])
+    # 8 tokens, E=2, k=2, factor 1.25 -> capacity 10 > n_local 8
+    moe = ShardedMixtureOfExperts(
+        mesh, hidden_dim=16, num_experts=2, k=2,
+        dtype=jnp.float32, gating="expert_choice",
+    )
+    p = moe.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+    y, aux = moe(p, x)
+    assert y.shape == x.shape
+    assert float(aux["dropped_fraction"]) == 0.0  # C=n covers all tokens
