@@ -235,3 +235,95 @@ def test_expert_choice_capacity_clamped_to_token_count():
     plan = expert_choice_gating(logits, capacity=10)  # 10 > n=8
     assert plan.token_for_slot.shape == (2, 8)
     assert float(plan.uncovered_fraction) == 0.0  # C=n covers everything
+
+
+class TestFusedAdafactor:
+    """Parity of ops.fused_adafactor vs optax.adafactor (the 6-traversal
+    chain it replaces — see the module docstring for the measured cost)."""
+
+    def _tree(self, dtype):
+        rs = np.random.RandomState(0)
+        mk = lambda *s: jnp.asarray(rs.randn(*s).astype(np.float32)).astype(dtype)
+        return {
+            "w_big": mk(256, 512),      # factored (both dims >= 128)
+            "w_small": mk(64, 32),      # 2-D but unfactored (dims < 128)
+            "bias": mk(512),            # 1-D: unfactored
+            "stack": mk(3, 256, 512),   # 3-D: factors the two largest dims
+            "scalar": jnp.asarray(0.5, dtype),
+        }
+
+    def _grads(self, dtype, seed):
+        rs = np.random.RandomState(seed)
+        mk = lambda *s: jnp.asarray(0.1 * rs.randn(*s).astype(np.float32)).astype(dtype)
+        return {
+            "w_big": mk(256, 512),
+            "w_small": mk(64, 32),
+            "bias": mk(512),
+            "stack": mk(3, 256, 512),
+            "scalar": jnp.asarray(0.01, dtype),
+        }
+
+    @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-5), (jnp.bfloat16, 2e-2)])
+    def test_matches_optax_adafactor(self, dtype, tol):
+        import optax
+
+        from learning_at_home_tpu.ops.fused_adafactor import fused_adafactor
+
+        params_ref = self._tree(dtype)
+        params_fused = self._tree(dtype)
+        ref = optax.adafactor(1e-2)
+        fused = fused_adafactor(1e-2)
+        s_ref = ref.init(params_ref)
+        s_fused = fused.init(params_fused)
+
+        for step in range(5):
+            grads = self._grads(dtype, seed=step + 1)
+            u_ref, s_ref = ref.update(grads, s_ref, params_ref)
+            u_fused, s_fused = fused.update(grads, s_fused, params_fused)
+            params_ref = optax.apply_updates(params_ref, u_ref)
+            params_fused = optax.apply_updates(params_fused, u_fused)
+            for k in params_ref:
+                np.testing.assert_allclose(
+                    np.asarray(params_fused[k], np.float32),
+                    np.asarray(params_ref[k], np.float32),
+                    rtol=tol, atol=tol, err_msg=f"step {step} leaf {k}",
+                )
+
+    def test_state_layout_matches_for_sharding_and_checkpoint(self):
+        """v_row/v_col/v mirror the param tree with the same reduced
+        shapes as optax, so opt_state_shardings and orbax treat it alike."""
+        import optax
+
+        from learning_at_home_tpu.ops.fused_adafactor import fused_adafactor
+
+        params = self._tree(jnp.float32)
+        s_ref = optax.adafactor(1e-2).init(params)
+        s_fused = fused_adafactor(1e-2).init(params)
+        # optax wraps in a chain tuple; ours is the bare factored state
+        ref_f = s_ref[0]
+        for field in ("v_row", "v_col", "v"):
+            a = jax.tree.map(jnp.shape, getattr(ref_f, field))
+            b = jax.tree.map(jnp.shape, getattr(s_fused, field))
+            assert a == b, (field, a, b)
+
+    def test_weight_decay_and_no_clip_variants(self):
+        import optax
+
+        from learning_at_home_tpu.ops.fused_adafactor import fused_adafactor
+
+        params = self._tree(jnp.float32)
+        grads = self._grads(jnp.float32, seed=7)
+        for kwargs in (
+            {"weight_decay_rate": 1e-3},
+            {"clipping_threshold": None},
+            {"multiply_by_parameter_scale": False},
+        ):
+            ref = optax.adafactor(1e-2, **kwargs)
+            fused = fused_adafactor(1e-2, **kwargs)
+            u_ref, _ = ref.update(grads, ref.init(params), params)
+            u_fused, _ = fused.update(grads, fused.init(params), params)
+            for k in params:
+                np.testing.assert_allclose(
+                    np.asarray(u_fused[k]), np.asarray(u_ref[k]),
+                    rtol=2e-5, atol=1e-7, err_msg=str(kwargs),
+                )
