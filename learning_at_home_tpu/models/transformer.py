@@ -66,11 +66,18 @@ class DMoETransformerConfig:
     # 'xla' = jax.nn.dot_product_attention (materializes [B,H,S,S]);
     # 'flash' = TPU Pallas flash-attention kernel (O(S) memory) — TPU
     # only, seq_len must divide the kernel block (min(512, S));
-    # 'auto' = flash on TPU at seq_len >= 8192, else xla.  Measured on
-    # the v5e (4-layer/64-expert, remat): flash loses at 2048 (199 vs
-    # 161 ms/step), ties at 4096, wins 8.6× at 8192 (446 vs 3819 ms —
-    # the materialized scores hit an HBM cliff), and is within 15% at
-    # 16384 with none of XLA's cliff behavior.
+    # 'auto' = flash on TPU at seq_len >= 8192, else xla.
+    # Measured table (v5e, 4-layer/64-expert, remat, tok/s): 2048 XLA
+    # 101.7k vs flash 82.3k; 4096 tie (57.9 vs 57.1); 8192 flash 8.6x
+    # (36.7k vs 4.3k — materialized scores hit the HBM cliff); 16384
+    # XLA 24.8k vs flash 21.5k.  Auto still picks flash at 16384 — a
+    # DELIBERATE exception to the measured winner: XLA's win there came
+    # from a batch small enough that [B,H,S,S] fit (B*H*S*S*2 bytes;
+    # at S=16384 even B=2,H=8 is 8.6 GB), and growing batch or heads
+    # re-enters the 8192-style cliff, while flash stays O(S).  Paying
+    # a measured -13% at one swept point buys a path whose memory does
+    # not explode with batch; pass attn_impl='xla' explicitly to take
+    # the 16384 point's winner at small batch.
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -240,7 +247,7 @@ class DMoETransformerLM:
         q, k, v = qkv_projections(lp, x, self.cfg.n_heads)
         return output_projection(lp, self._ring(q, k, v))
 
-    def _layer(self, lp, x):
+    def _layer(self, lp, x, layer_idx):
         attn = self._ring_attention if self._ring is not None else (
             lambda lp, x: causal_attention(
                 lp, x, self.cfg.n_heads, impl=self.cfg.attn_impl
@@ -249,7 +256,9 @@ class DMoETransformerLM:
         x = x + attn(lp, layer_norm(lp["ln1"], x))
         b, s, d = x.shape
         moe_in = layer_norm(lp["ln2"], x).reshape(b * s, d)
-        moe_out, aux = self.moe(lp["moe"], moe_in)
+        # layer index salts the router jitter: decorrelates the
+        # deterministic noise pattern across layers (round-2 advisor)
+        moe_out, aux = self.moe(lp["moe"], moe_in, jitter_salt=layer_idx)
         x = x + moe_out.reshape(b, s, d)
         return x, aux
 
@@ -275,8 +284,9 @@ class DMoETransformerLM:
                     f"{cfg.remat_policy!r}"
                 )
 
-        def body(x, lp):
-            x, aux = layer_fn(lp, x)
+        def body(x, lp_idx):
+            lp, idx = lp_idx
+            x, aux = layer_fn(lp, x, idx)
             return x, aux
 
         if self._zig is not None:
@@ -291,8 +301,13 @@ class DMoETransformerLM:
             # independent); positions were already added above
             x = x[:, self._zig]
         if cfg.scan_layers:
-            # scan over the stacked layer params: ONE compiled layer body
-            x, aux_stack = jax.lax.scan(body, x, params["layers"])
+            # scan over the stacked layer params: ONE compiled layer body;
+            # the layer index rides along as data (it is traced, so it can
+            # still salt the router-jitter key inside the body)
+            x, aux_stack = jax.lax.scan(
+                body, x,
+                (params["layers"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
+            )
             aux_total = {k: jnp.sum(v) for k, v in aux_stack.items()}
         else:
             # unrolled: per-layer params, either static slices of the
@@ -305,7 +320,7 @@ class DMoETransformerLM:
                     if cfg.stack_layers
                     else params["layers"][i]
                 )
-                x, aux = layer_fn(lp, x)
+                x, aux = layer_fn(lp, x, i)
                 aux_total = (
                     aux
                     if aux_total is None
@@ -338,6 +353,108 @@ class DMoETransformerLM:
         """token_ids [B, S] → logits [B, S, V] (f32); aux dict of scalars."""
         x, aux_mean = self._hidden(params, token_ids)
         return self._logits(x, self._head(params)), aux_mean
+
+    # ---- autoregressive decoding ----
+
+    def decode_model(self) -> "DMoETransformerLM":
+        """The model to EVALUATE/DECODE with — identical weights, eval-safe
+        routing.
+
+        Two train-time routing behaviors cannot be reproduced
+        autoregressively and are switched off here:
+
+        - ``gating='expert_choice'``: each expert picks its top-C tokens
+          *of the batch*, so routing is batch-dependent (the documented
+          causality leak in ``ops.moe_dispatch.expert_choice_gating``).
+          At decode there is no batch to pick from — with one live token,
+          capacity clamps to 1 and EVERY expert would select that token,
+          a regime the router never saw in training.  Decode therefore
+          falls back to token-choice top-k over the same gate affinities
+          (the expert-choice paper's own inference recipe is a learned
+          router/top-k approximation; plain top-k is the zero-extra-state
+          version).  Expect a quality gap vs teacher-forced eval — the
+          training CE of an expert-choice model includes routing that
+          decode cannot see (BASELINE.md notes this on the CE-parity row).
+        - ``router_jitter``: selection noise is a training-only
+          regularizer; decode routes on clean gates.
+        """
+        cfg = self.cfg
+        changed = {}
+        if cfg.gating == "expert_choice":
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "expert_choice routing is batch-dependent and cannot be "
+                "reproduced at autoregressive decode; falling back to "
+                "token-choice top-%d routing over the same gate "
+                "affinities (see DMoETransformerLM.decode_model)",
+                cfg.k,
+            )
+            changed["gating"] = "topk"
+        if cfg.router_jitter:
+            changed["router_jitter"] = 0.0
+        if not changed:
+            return self
+        return DMoETransformerLM(dataclasses.replace(self.cfg, **changed), self.mesh)
+
+    def generate(
+        self,
+        params: Params,
+        prompt_ids: jax.Array,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        rng: jax.Array | None = None,
+    ) -> jax.Array:
+        """Greedy (or temperature-sampled) autoregressive decoding.
+
+        prompt_ids: [B, P] int32 with P + max_new_tokens <= seq_len.
+        Returns [B, P + max_new_tokens].  Each step re-runs the full
+        forward over the fixed-length buffer (static shapes for XLA;
+        causality makes the right-padding inert) — the straightforward
+        eval path, not a KV-cache serving stack.  Routing follows
+        :meth:`decode_model` (token-choice, no jitter).
+        """
+        b, p = prompt_ids.shape
+        s = self.cfg.seq_len
+        if p + max_new_tokens > s:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"seq_len {s}"
+            )
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if temperature > 0 and rng is None:
+            raise ValueError("temperature > 0 requires an rng key")
+        model = self.decode_model()
+        buf = jnp.zeros((b, s), prompt_ids.dtype).at[:, :p].set(prompt_ids)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # unused at temperature == 0
+
+        def step(carry, t):
+            buf, rng = carry
+            logits, _ = model.apply(params, buf)
+            step_logits = jax.lax.dynamic_index_in_dim(
+                logits, t, axis=1, keepdims=False
+            )  # [B, V]
+            rng, sub = jax.random.split(rng)
+            if temperature > 0:  # static: resolved at trace time
+                nxt = jax.random.categorical(sub, step_logits / temperature)
+            else:
+                nxt = jnp.argmax(step_logits, axis=-1)
+            nxt = nxt.astype(buf.dtype)
+            # only write while t is a real decode position (static bound
+            # covers the scan length; writes are always in range here)
+            buf = jax.vmap(
+                lambda row, v, i: jax.lax.dynamic_update_index_in_dim(row, v, i, 0)
+            )(buf, nxt, jnp.full((b,), t + 1))
+            return (buf, rng), None
+
+        (buf, _), _ = jax.lax.scan(
+            step,
+            (buf, rng),
+            jnp.arange(p - 1, p - 1 + max_new_tokens, dtype=jnp.int32),
+        )
+        return buf[:, : p + max_new_tokens]
 
     # ---- loss / train step ----
 

@@ -139,13 +139,14 @@ def _top_k(x: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
 
 
 def _topk_weights(
-    gates: jax.Array, k: int, renormalize: bool, jitter: float = 0.0
+    gates: jax.Array, k: int, renormalize: bool, jitter: float = 0.0,
+    jitter_salt: jax.Array | int = 0,
 ):
     """Top-k selection with optional jitter.  Jitter perturbs ONLY which
     experts are selected; the combine weights always come from the clean
     gates, so the fixed noise pattern never biases the output mixture."""
     if jitter:
-        _, top_i = _top_k(router_jitter(gates, jitter), k)
+        _, top_i = _top_k(router_jitter(gates, jitter, jitter_salt), k)
         top_w = jnp.take_along_axis(gates, top_i, axis=-1)
     else:
         top_w, top_i = _top_k(gates, k)
@@ -156,7 +157,9 @@ def _topk_weights(
     return top_w, top_i
 
 
-def router_jitter(gates: jax.Array, jitter: float) -> jax.Array:
+def router_jitter(
+    gates: jax.Array, jitter: float, salt: jax.Array | int = 0
+) -> jax.Array:
     """Switch-Transformer-style multiplicative routing noise,
     U(1-jitter, 1+jitter) per (row, expert) — but DETERMINISTIC: the
     pattern comes from a fixed PRNG key, not threaded randomness.
@@ -169,11 +172,19 @@ def router_jitter(gates: jax.Array, jitter: float) -> jax.Array:
     shuffles text across rows every step, so a fixed row↦noise map is
     uncorrelated with content; and the backward's re-forward (remat,
     custom_vjp) reproduces the identical routing, which threaded
-    randomness would make harder to guarantee."""
+    randomness would make harder to guarantee.
+
+    ``salt`` (static int or traced scalar — e.g. the layer index carried
+    through a ``lax.scan`` over layers) decorrelates the row↦noise map
+    across call sites: without it every layer reuses one pattern, so the
+    same row positions get the same selection bias everywhere, weakening
+    the tie-breaking the noise exists to provide (round-2 advisor
+    finding)."""
     if not jitter:
         return gates
+    key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), salt)
     noise = jax.random.uniform(
-        jax.random.PRNGKey(0x5EED), gates.shape,
+        key, gates.shape,
         dtype=gates.dtype, minval=1.0 - jitter, maxval=1.0 + jitter,
     )
     return gates * noise
@@ -181,7 +192,7 @@ def router_jitter(gates: jax.Array, jitter: float) -> jax.Array:
 
 def top_k_gating(
     logits: jax.Array, k: int, capacity: int, renormalize: bool = True,
-    jitter: float = 0.0,
+    jitter: float = 0.0, jitter_salt: jax.Array | int = 0,
 ) -> DispatchPlan:
     """Route each token to its top-k experts, bucketed to static capacity.
 
@@ -192,7 +203,7 @@ def top_k_gating(
     """
     n, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)  # [n, E]
-    top_w, top_i = _topk_weights(gates, k, renormalize, jitter)
+    top_w, top_i = _topk_weights(gates, k, renormalize, jitter, jitter_salt)
     pos = _expert_positions(top_i, num_experts)  # [n, k]
     fits = pos < capacity
 
@@ -223,14 +234,14 @@ def combine_outputs(y: jax.Array, plan: DispatchPlan) -> jax.Array:
 
 def top_k_gating_indices(
     logits: jax.Array, k: int, capacity: int, renormalize: bool = True,
-    jitter: float = 0.0,
+    jitter: float = 0.0, jitter_salt: jax.Array | int = 0,
 ) -> IndexDispatchPlan:
     """Index-form routing: same semantics as :func:`top_k_gating`
     (token-order slot claims, capacity dropping, renormalized weights)
     without ever materializing [n, E, C] tensors."""
     n, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)
-    top_w, top_i = _topk_weights(gates, k, renormalize, jitter)
+    top_w, top_i = _topk_weights(gates, k, renormalize, jitter, jitter_salt)
     pos = _expert_positions(top_i, num_experts)  # [n, k]
     fits = pos < capacity
 

@@ -73,6 +73,14 @@ def opt_state_shardings(abstract_opt_state, param_shardings, params, mesh: Mesh)
     Everything else (step counts, scalars) is replicated.  Needed because
     ``jit(opt.init)`` does not propagate NamedShardings to its outputs, and
     a checkpoint restored onto mismatched devices poisons the train step.
+
+    LIMIT of the heuristic (round-2 advisor): the suffix+shape match is
+    positional-blind — an optimizer whose state leaf coincidentally has
+    the param's exact shape but different per-axis SEMANTICS (e.g. a
+    transposed statistic) would silently inherit the param's spec.  The
+    optimizers in use (adamw, adafactor, ops.fused_adafactor) are covered
+    by tests; new optimizers with same-shape/different-semantics state
+    need an explicit sharding override instead of this helper.
     """
     shard_map_ = {
         jax.tree_util.keystr(path): s

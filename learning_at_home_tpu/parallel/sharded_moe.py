@@ -184,8 +184,15 @@ class ShardedMixtureOfExperts:
 
     # ---- the sharded program ----
 
-    def __call__(self, params: Params, x: jax.Array) -> tuple[jax.Array, dict]:
-        """x: [n_tokens, d] sharded over the data axes.  Returns (y, aux)."""
+    def __call__(
+        self, params: Params, x: jax.Array,
+        jitter_salt: jax.Array | int = 0,
+    ) -> tuple[jax.Array, dict]:
+        """x: [n_tokens, d] sharded over the data axes.  Returns (y, aux).
+
+        ``jitter_salt``: static int or traced scalar (e.g. the layer index
+        inside a scan-over-layers) folded into the router-jitter key so
+        each call site draws a decorrelated noise pattern."""
         n_global = x.shape[0]
         n_shards = 1
         for a in self._shard:
@@ -210,6 +217,7 @@ class ShardedMixtureOfExperts:
             in_specs=(
                 self.param_specs(),
                 P(self._shard),
+                P(),  # jitter salt: replicated scalar
             ),
             out_specs=(
                 P(self._shard),
@@ -217,10 +225,11 @@ class ShardedMixtureOfExperts:
             ),
             check_vma=False,
         )
-        return fn(params, x)
+        return fn(params, x, jnp.asarray(jitter_salt, jnp.int32))
 
     def _local_forward(
-        self, params: Params, x: jax.Array, capacity: int
+        self, params: Params, x: jax.Array, jitter_salt: jax.Array,
+        capacity: int,
     ) -> tuple[jax.Array, dict]:
         e_local = self.num_experts // self.ep
         d = self.hidden_dim
@@ -241,12 +250,14 @@ class ShardedMixtureOfExperts:
             x_send = dispatch_tokens_expert_choice(x.astype(compute), plan)
         elif impl == "gather":
             plan = top_k_gating_indices(
-                logits, self.k, capacity, jitter=self.router_jitter
+                logits, self.k, capacity, jitter=self.router_jitter,
+                jitter_salt=jitter_salt,
             )
             x_send = dispatch_tokens_indexed(x.astype(compute), plan)
         else:
             plan = top_k_gating(
-                logits, self.k, capacity, jitter=self.router_jitter
+                logits, self.k, capacity, jitter=self.router_jitter,
+                jitter_salt=jitter_salt,
             )
             x_send = dispatch_tokens(x.astype(compute), plan)  # [E, C, d]
         x_send = x_send.reshape(self.ep, e_local, capacity, d)
