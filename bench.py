@@ -32,11 +32,12 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # Prior-round bests to compute vs_baseline against (BASELINE.md).
 BASELINE_TPS = {
     "cpu": 190.0,  # round-1 CPU fallback, shrunk config
-    # Round-2 best real-chip number (v5e, 256 experts, batch 176 +
-    # remat, fetch-forced timing — block_until_ready does NOT block
-    # through the axon tunnel; see BASELINE.md for the progression
-    # 32.3k → 99.8k → 152.3k tok/s within round 2).
-    "tpu": 152342.0,
+    # Round-3 best real-chip number (v5e, 256 experts, batch 176, remat +
+    # fused adafactor + unrolled/unstacked layers, fetch-forced timing —
+    # block_until_ready does NOT block through the axon tunnel; see
+    # BASELINE.md for the progression 32.3k → 99.8k → 152.3k → 165.0k
+    # tok/s across rounds 2-3).
+    "tpu": 165040.0,
 }
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
 TPU_PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
@@ -53,8 +54,8 @@ def _tail(s: str, n: int = 800) -> str:
     return s[-n:] if s else ""
 
 
-def probe_platform(deadline: int = 75) -> str | None:
-    """Resolve the ambient JAX platform in a throwaway subprocess."""
+def _probe_once(deadline: int) -> tuple[str | None, str]:
+    """One probe attempt: (platform or None, error description)."""
     try:
         r = subprocess.run(
             [sys.executable, "-c", PROBE_SRC.format(dl=deadline)],
@@ -64,18 +65,44 @@ def probe_platform(deadline: int = 75) -> str | None:
             cwd=REPO,
         )
     except subprocess.TimeoutExpired:
-        print("bench: platform probe timed out", file=sys.stderr)
-        return None
+        return None, "probe subprocess timed out"
     for line in r.stdout.splitlines():
         if line.startswith("PROBE_PLATFORM="):
-            return line.split("=", 1)[1].strip()
-    print(f"bench: platform probe failed rc={r.returncode}: "
-          f"{_tail(r.stderr)}", file=sys.stderr)
-    return None
+            return line.split("=", 1)[1].strip(), ""
+    return None, f"rc={r.returncode}: {_tail(r.stderr, 300)}"
 
 
-def run_worker(env: dict, deadline: int, label: str) -> dict | None:
-    """Run ``bench.py --worker`` under ``env``; parse its last JSON line."""
+def probe_platform(deadline: int = 75, attempts: int = 3) -> tuple[str | None, str]:
+    """Resolve the ambient JAX platform, retrying a wedged/slow tunnel.
+
+    One failed 75 s probe used to silently forfeit the round's TPU
+    evidence (round-3 postmortem); the tunnel recovers on minute
+    timescales, so retry with backoff before conceding to CPU.  Returns
+    ``(platform, last_error)`` so the fallback JSON can say WHY."""
+    last_err = ""
+    for i in range(attempts):
+        if i:
+            backoff = 15 * i
+            print(f"bench: probe retry {i + 1}/{attempts} in {backoff}s "
+                  f"(last: {last_err.splitlines()[0] if last_err else '?'})",
+                  file=sys.stderr)
+            time.sleep(backoff)
+        platform, last_err = _probe_once(deadline)
+        if platform:
+            return platform, ""
+    return None, last_err
+
+
+# exit code for DELIBERATE worker refusals (analytic HBM guard): a retry
+# would deterministically refuse again, so main() must not spend a second
+# deadline on it
+REFUSED_RC = 3
+
+
+def run_worker(env: dict, deadline: int, label: str) -> tuple[dict | None, int]:
+    """Run ``bench.py --worker`` under ``env``; parse its last JSON line.
+    Returns (result, returncode) — rc REFUSED_RC marks a deliberate,
+    deterministic refusal that must not be retried."""
     env = dict(env)
     env["BENCH_DEADLINE_S"] = str(deadline)
     try:
@@ -90,6 +117,38 @@ def run_worker(env: dict, deadline: int, label: str) -> dict | None:
     except subprocess.TimeoutExpired as e:
         print(f"bench[{label}]: worker timed out after {deadline + 30}s\n"
               f"{_tail(str(e.stdout))}\n{_tail(str(e.stderr))}", file=sys.stderr)
+        return None, -1
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line), r.returncode
+            except json.JSONDecodeError:
+                continue
+    print(f"bench[{label}]: worker rc={r.returncode}, no JSON line\n"
+          f"stdout: {_tail(r.stdout)}\nstderr: {_tail(r.stderr)}",
+          file=sys.stderr)
+    return None, r.returncode
+
+
+def run_dispatch_microbench(deadline: int = 150) -> dict | None:
+    """Swarm-tier dispatch p50 ([BJ] north-star metric #2) in a scrubbed
+    CPU subprocess: 4 FFN experts on one loopback server, top-2 gating
+    through ``RemoteMixtureOfExperts``, ~25 forward+backward dispatches."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_DEADLINE_S"] = str(deadline)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--dispatch-worker"],
+            capture_output=True, text=True, timeout=deadline + 30,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: dispatch microbench timed out", file=sys.stderr)
         return None
     for line in reversed(r.stdout.splitlines()):
         line = line.strip()
@@ -98,9 +157,8 @@ def run_worker(env: dict, deadline: int, label: str) -> dict | None:
                 return json.loads(line)
             except json.JSONDecodeError:
                 continue
-    print(f"bench[{label}]: worker rc={r.returncode}, no JSON line\n"
-          f"stdout: {_tail(r.stdout)}\nstderr: {_tail(r.stderr)}",
-          file=sys.stderr)
+    print(f"bench: dispatch microbench rc={r.returncode}, no JSON\n"
+          f"stderr: {_tail(r.stderr)}", file=sys.stderr)
     return None
 
 
@@ -108,13 +166,31 @@ def main() -> int:
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     ambient = os.environ.get("JAX_PLATFORMS", "")
     result = None
+    probe_err = ""
 
     if not force_cpu and ambient not in ("cpu",):
-        platform = probe_platform()
+        platform, probe_err = probe_platform()
         if platform and platform != "cpu":
             print(f"bench: ambient platform '{platform}' is live; "
                   "benchmarking on it", file=sys.stderr)
-            result = run_worker(dict(os.environ), deadline=420, label=platform)
+            result, rc = run_worker(
+                dict(os.environ), deadline=420, label=platform
+            )
+            if result is None and rc != REFUSED_RC:
+                # the probe saw a live chip but the worker died on what may
+                # be a transient tunnel flake: one more attempt before
+                # conceding the round's TPU evidence.  Deliberate refusals
+                # (analytic HBM guard) are deterministic — no retry.
+                print("bench: TPU worker failed; retrying once",
+                      file=sys.stderr)
+                time.sleep(20)
+                result, rc = run_worker(
+                    dict(os.environ), deadline=420, label=platform
+                )
+                if result is None:
+                    probe_err = "probe ok but TPU worker failed twice"
+            elif result is None:
+                probe_err = "worker refused (model does not fit HBM budget)"
         else:
             print("bench: no usable accelerator platform; falling back to CPU",
                   file=sys.stderr)
@@ -124,7 +200,11 @@ def main() -> int:
 
         env = clean_jax_subprocess_env(repo_root=REPO)
         env.pop("XLA_FLAGS", None)  # no virtual multi-device for the bench
-        result = run_worker(env, deadline=300, label="cpu")
+        result, _ = run_worker(env, deadline=300, label="cpu")
+        if result is not None and probe_err:
+            # distinguish "tunnel down" from "framework broken" in the
+            # graded artifact (round-3 verdict: the JSON didn't say why)
+            result["tpu_unavailable"] = probe_err.splitlines()[0][:200]
 
     if result is None:  # even the CPU fallback failed: still emit the line
         result = {
@@ -135,6 +215,13 @@ def main() -> int:
             "platform": "none",
             "error": "both TPU and CPU bench workers failed; see stderr",
         }
+
+    if result.get("value"):
+        # north-star metric #2: swarm dispatch p50 (always CPU/host-side —
+        # the DCN tier's latency does not depend on the accelerator)
+        disp = run_dispatch_microbench()
+        if disp:
+            result.update(disp)
     print(json.dumps(result), flush=True)
     return 0
 
@@ -218,6 +305,7 @@ def _activation_bytes(cfg, batch: int) -> int:
 def worker() -> None:
     import faulthandler
 
+    t_start = time.perf_counter()
     deadline = int(os.environ.get("BENCH_DEADLINE_S", "420"))
     faulthandler.dump_traceback_later(deadline, exit=True)
 
@@ -248,7 +336,22 @@ def worker() -> None:
         # enough activation HBM to triple the batch — measured (v5e,
         # 2026-07-29): no-remat peaks at 99.8k tok/s (batch 56); remat
         # 112→127k, 144→140k, 176→150k, 208→150k (plateau).
-        cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16, remat=True)
+        # scan_layers=False / stack_layers=False: the round-3 winning
+        # recipe (unrolled loop over per-layer param tuples) kills the
+        # stacked-grad dynamic-update-slice writes and the per-step
+        # slice-out copies — 294.6 → 273.0 ms/step with the fused
+        # optimizer (BASELINE.md round-3 table).
+        scan = os.environ.get("BENCH_SCAN", "0") == "1"
+        # scan requires the stacked param layout; default stack to follow
+        # scan so BENCH_SCAN=1 alone reproduces the round-2 scan recipe
+        stack = os.environ.get("BENCH_STACK", "1" if scan else "0") == "1"
+        cfg = dataclasses.replace(
+            cfg,
+            param_dtype=jnp.bfloat16,
+            remat=True,
+            scan_layers=scan,
+            stack_layers=stack,
+        )
         model = DMoETransformerLM(cfg, mesh)
     else:  # local smoke only: shrink to something a 1-core CPU can turn
         cfg = dataclasses.replace(cfg, num_experts=8, dtype=jnp.float32)
@@ -257,20 +360,41 @@ def worker() -> None:
         cfg = dataclasses.replace(cfg, num_experts=int(os.environ["BENCH_EXPERTS"]))
         model = DMoETransformerLM(cfg, mesh)
 
-    opt_name = os.environ.get("BENCH_OPT", "adafactor" if on_tpu else "adamw")
-    if opt_name not in ("adafactor", "adamw"):
-        raise ValueError(f"BENCH_OPT must be adafactor|adamw, got {opt_name!r}")
-    optimizer = (
-        optax.adafactor(1e-3) if opt_name == "adafactor" else optax.adamw(1e-3)
-    )
+    # TPU default is the round-3 winner: single-traversal Adafactor with
+    # the param add folded into the optimizer's final pass
+    # (ops/fused_adafactor.py; state layout identical to optax.adafactor).
+    opt_name = os.environ.get("BENCH_OPT", "fused" if on_tpu else "adamw")
+    if opt_name not in ("adafactor", "adamw", "fused"):
+        raise ValueError(
+            f"BENCH_OPT must be adafactor|adamw|fused, got {opt_name!r}"
+        )
+    if opt_name == "fused":
+        from learning_at_home_tpu.ops.fused_adafactor import fused_adafactor
+
+        optimizer = fused_adafactor(1e-3)
+    elif opt_name == "adafactor":
+        optimizer = optax.adafactor(1e-3)
+    else:
+        optimizer = optax.adamw(1e-3)
 
     # Analytic batch selection — NEVER probe batch sizes by catching OOM
     # on the axon backend: a server-side OOM wedges the TPU tunnel for
     # every subsequent process (observed 2026-07-29: bench batch=128
     # OOM'd and backend init hung for all later processes).
+    accum = int(os.environ.get("BENCH_ACCUM", "1"))
     hbm = TPU_HBM_BYTES.get(os.environ.get("PALLAS_AXON_TPU_GEN", ""), 16e9)
     budget = 0.75 * hbm
     static_b = _static_state_bytes(model, optimizer)
+    if accum > 1:
+        # the accum path keeps a param-sized f32 gradient-sum tree live
+        # across microbatches (round-3 advisor: the analytic guard missed
+        # it — ~8.6 GB at the bf16 flagship, decisive on a 16 GB v5e)
+        abstract_params = jax.eval_shape(
+            model.init_params, jax.random.PRNGKey(0)
+        )
+        static_b += 4 * sum(
+            l.size for l in jax.tree_util.tree_leaves(abstract_params)
+        )
     if os.environ.get("BENCH_BATCH"):
         batch = int(os.environ["BENCH_BATCH"])
     elif on_tpu:
@@ -290,26 +414,32 @@ def worker() -> None:
             print(f"bench worker: static state alone is {static_b / 1e9:.1f} "
                   f"GB vs budget {budget / 1e9:.1f} GB; refusing to risk an "
                   "OOM on the shared tunnel", file=sys.stderr)
-            sys.exit(1)
+            sys.exit(REFUSED_RC)  # deterministic refusal: do NOT retry
     else:
         batch = 4
     est_gb = (static_b + _activation_bytes(cfg, batch)) / 1e9
-    print(f"bench worker: batch={batch} (estimated peak {est_gb:.1f} GB, "
-          f"budget {budget / 1e9:.1f} GB, opt={opt_name})", file=sys.stderr)
+    print(f"bench worker: batch={batch} accum={accum} (estimated peak "
+          f"{est_gb:.1f} GB, budget {budget / 1e9:.1f} GB, opt={opt_name})",
+          file=sys.stderr)
 
     params = model.init_params(jax.random.PRNGKey(0))
     opt_state = model.init_opt_state(optimizer, params)
-    step = model.make_train_step(optimizer)
+    step = model.make_train_step(optimizer, accum_steps=accum)
     sharding = batch_sharding(mesh)
+    if accum > 1:  # leading microbatch axis is unsharded (matches the step)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P(None, *sharding.spec))
     rs = np.random.RandomState(0)
 
+    data_shape = (
+        (accum, batch, cfg.seq_len) if accum > 1 else (batch, cfg.seq_len)
+    )
     ids = jax.device_put(
-        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))),
-        sharding,
+        jnp.asarray(rs.randint(0, cfg.vocab_size, data_shape)), sharding
     )
     tgt = jax.device_put(
-        jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, cfg.seq_len))),
-        sharding,
+        jnp.asarray(rs.randint(0, cfg.vocab_size, data_shape)), sharding
     )
     def fence(*trees) -> None:
         """Prove device work finished by FETCHING a value that depends on
@@ -333,25 +463,28 @@ def worker() -> None:
     fence(params, opt_state, loss)
     elapsed = time.perf_counter() - t0
 
-    tokens_per_step = batch * cfg.seq_len
+    tokens_per_step = accum * batch * cfg.seq_len
     tps = tokens_per_step * n_steps / elapsed
     step_s = elapsed / n_steps
     result = {
         "metric": "DMoE-Transformer training throughput "
         f"({cfg.num_experts} experts, d_model={cfg.d_model}, "
-        f"L={cfg.n_layers}, seq={cfg.seq_len}, batch={batch}, top-{cfg.k})",
+        f"L={cfg.n_layers}, seq={cfg.seq_len}, batch={batch}"
+        + (f"x{accum}" if accum > 1 else "")
+        + f", top-{cfg.k})",
         "value": round(tps, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tps / BASELINE_TPS[platform], 3)
         if platform in BASELINE_TPS else 1.0,
         "platform": platform,
         "step_ms": round(1000 * step_s, 2),
+        "optimizer": opt_name,
         "final_loss": round(float(loss), 4),
         "dropped_fraction": round(float(metrics["dropped_fraction"]), 4),
     }
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
     if on_tpu and gen in TPU_PEAK_BF16:
-        flops = _model_flops_per_step(cfg, batch)
+        flops = _model_flops_per_step(cfg, accum * batch)
         result["mfu"] = round(flops / step_s / TPU_PEAK_BF16[gen], 4)
         result["tpu_gen"] = gen
     try:
@@ -361,12 +494,139 @@ def worker() -> None:
             result["hbm_peak_gb"] = round(peak / 1e9, 2)
     except Exception:
         pass
-    faulthandler.cancel_dump_traceback_later()
+
+    # The MAIN number is safe from here on: print it NOW, so that if the
+    # optional balanced variant below blows the faulthandler deadline the
+    # parent still parses this line (it takes the LAST JSON line, so a
+    # successful variant re-prints an augmented copy).
     print(json.dumps(result), flush=True)
+
+    # Balanced-routing regime ([BJ]: real training sits at dropped < 0.25,
+    # not the init-router 0.41 of random tokens — round-3 verdict task 7):
+    # router jitter spreads near-identical rows and the aux loss gets ~30
+    # steps to act, then 10 timed steps report tok/s in that regime.
+    t_used = time.perf_counter() - t_start
+    if (
+        on_tpu
+        and os.environ.get("BENCH_BALANCED", "1") == "1"
+        and deadline - t_used > 150
+    ):
+        try:
+            result["balanced"] = _balanced_variant(
+                cfg, mesh, optimizer, batch, batch_sharding(mesh), fence
+            )
+            print(json.dumps(result), flush=True)
+        except Exception as e:  # never forfeit the main number
+            print(f"bench worker: balanced variant failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    faulthandler.cancel_dump_traceback_later()
+
+
+def _balanced_variant(cfg, mesh, optimizer, batch, sharding, fence) -> dict:
+    """tok/s + dropped_fraction with router_jitter 0.1 + aux 5e-2 after 30
+    balance-training steps (the round-2 recipe that reaches dropped
+    0.15-0.23 on the flagship)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_at_home_tpu.models.transformer import DMoETransformerLM
+
+    bcfg = dataclasses.replace(
+        cfg, router_jitter=0.1, aux_loss_weight=5e-2
+    )
+    bmodel = DMoETransformerLM(bcfg, mesh)
+    params = bmodel.init_params(jax.random.PRNGKey(0))
+    opt_state = bmodel.init_opt_state(optimizer, params)
+    step = bmodel.make_train_step(optimizer)
+    rs = np.random.RandomState(1)
+    ids = jax.device_put(
+        jnp.asarray(rs.randint(0, bcfg.vocab_size, (batch, bcfg.seq_len))),
+        sharding,
+    )
+    tgt = jax.device_put(
+        jnp.asarray(rs.randint(0, bcfg.vocab_size, (batch, bcfg.seq_len))),
+        sharding,
+    )
+    for _ in range(30):  # let the aux loss balance the router
+        params, opt_state, loss, metrics = step(params, opt_state, ids, tgt)
+    fence(params, loss)
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, opt_state, loss, metrics = step(params, opt_state, ids, tgt)
+    fence(params, loss)
+    step_s = (time.perf_counter() - t0) / n
+    return {
+        "regime": "router_jitter=0.1 aux=5e-2, 30 balance steps",
+        "tokens_per_sec": round(batch * bcfg.seq_len / step_s, 1),
+        "step_ms": round(1000 * step_s, 2),
+        "dropped_fraction": round(float(metrics["dropped_fraction"]), 4),
+    }
+
+
+# --------------------------------------------------------------------------
+# dispatch worker: swarm-tier dispatch p50 microbench (loopback, CPU)
+# --------------------------------------------------------------------------
+
+
+def dispatch_worker() -> None:
+    """4 FFN experts, top-2 gating, ~25 fwd+bwd dispatches through
+    ``RemoteMixtureOfExperts`` on a loopback server; prints a JSON line
+    with dispatch_p50_ms / dispatch_p99_ms from the layer's own
+    telemetry deque (the [BJ] config-2 measurement)."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ.get("BENCH_DEADLINE_S", "150")), exit=True
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.server.server import background_server
+
+    hid, rows, n_dispatch = 64, 64, 25
+    with background_server(
+        num_experts=4, hidden_dim=hid, expert_prefix="bench", seed=0
+    ) as (endpoint, srv):
+        source = StaticExpertSource({uid: endpoint for uid in srv.experts})
+        moe = RemoteMixtureOfExperts(
+            in_features=hid, grid_size=(4,), uid_prefix="bench",
+            source=source, k_best=2, k_min=2,
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+
+        def loss(gate, x):
+            return jnp.sum(moe(x, gate) ** 2)
+
+        grad = jax.grad(loss)
+        for i in range(n_dispatch):
+            x = jnp.asarray(rs.randn(rows, hid).astype(np.float32))
+            grad(gate, x)  # forward + backward dispatch per call
+        # steady state: the first few calls include jit/trace warmup
+        times = np.asarray(moe.dispatch_times)[5:]
+        out = {
+            "dispatch_p50_ms": round(float(np.percentile(times, 50)) * 1e3, 2),
+            "dispatch_p99_ms": round(float(np.percentile(times, 99)) * 1e3, 2),
+            "dispatch_rows": rows,
+            "dispatch_n": int(times.size),
+        }
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         worker()
+        sys.exit(0)
+    if "--dispatch-worker" in sys.argv:
+        dispatch_worker()
         sys.exit(0)
     sys.exit(main())

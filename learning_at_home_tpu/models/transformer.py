@@ -247,7 +247,7 @@ class DMoETransformerLM:
         q, k, v = qkv_projections(lp, x, self.cfg.n_heads)
         return output_projection(lp, self._ring(q, k, v))
 
-    def _layer(self, lp, x, layer_idx):
+    def _layer(self, lp, x, layer_idx, token_mask=None):
         attn = self._ring_attention if self._ring is not None else (
             lambda lp, x: causal_attention(
                 lp, x, self.cfg.n_heads, impl=self.cfg.attn_impl
@@ -258,14 +258,25 @@ class DMoETransformerLM:
         moe_in = layer_norm(lp["ln2"], x).reshape(b * s, d)
         # layer index salts the router jitter: decorrelates the
         # deterministic noise pattern across layers (round-2 advisor)
-        moe_out, aux = self.moe(lp["moe"], moe_in, jitter_salt=layer_idx)
+        moe_out, aux = self.moe(
+            lp["moe"], moe_in, jitter_salt=layer_idx,
+            token_mask=None if token_mask is None else token_mask.reshape(b * s),
+        )
         x = x + moe_out.reshape(b, s, d)
         return x, aux
 
     def _hidden(
-        self, params: Params, token_ids: jax.Array
+        self, params: Params, token_ids: jax.Array,
+        token_mask: jax.Array | None = None,
     ) -> tuple[jax.Array, dict]:
-        """token_ids [B, S] → final-LN hidden states [B, S, d]; aux scalars."""
+        """token_ids [B, S] → final-LN hidden states [B, S, d]; aux scalars.
+
+        ``token_mask`` [B, S] bool (optional, traced): False marks padding
+        positions that must not participate in MoE routing (they claim no
+        expert capacity and receive zero MoE output) — used by
+        :meth:`generate` so a row's right-padding cannot evict other rows'
+        real tokens from expert slots.  Attention needs no mask: causality
+        already keeps real positions from attending to future padding."""
         cfg = self.cfg
         x = params["embed"][token_ids].astype(cfg.dtype)
         x = x + params["pos"][None, : token_ids.shape[1]].astype(cfg.dtype)
@@ -286,7 +297,7 @@ class DMoETransformerLM:
 
         def body(x, lp_idx):
             lp, idx = lp_idx
-            x, aux = layer_fn(lp, x, idx)
+            x, aux = layer_fn(lp, x, idx, token_mask)
             return x, aux
 
         if self._zig is not None:
@@ -300,6 +311,8 @@ class DMoETransformerLM:
             # consumes it natively; MoE and norms are per-token (order-
             # independent); positions were already added above
             x = x[:, self._zig]
+            if token_mask is not None:
+                token_mask = token_mask[:, self._zig]
         if cfg.scan_layers:
             # scan over the stacked layer params: ONE compiled layer body;
             # the layer index rides along as data (it is traced, so it can
@@ -320,7 +333,7 @@ class DMoETransformerLM:
                     if cfg.stack_layers
                     else params["layers"][i]
                 )
-                x, aux = layer_fn(lp, x, i)
+                x, aux = layer_fn(lp, x, i, token_mask)
                 aux_total = (
                     aux
                     if aux_total is None
@@ -349,9 +362,13 @@ class DMoETransformerLM:
             "...d,dv->...v", x, head, preferred_element_type=jnp.float32
         )
 
-    def apply(self, params: Params, token_ids: jax.Array) -> tuple[jax.Array, dict]:
-        """token_ids [B, S] → logits [B, S, V] (f32); aux dict of scalars."""
-        x, aux_mean = self._hidden(params, token_ids)
+    def apply(
+        self, params: Params, token_ids: jax.Array,
+        token_mask: jax.Array | None = None,
+    ) -> tuple[jax.Array, dict]:
+        """token_ids [B, S] → logits [B, S, V] (f32); aux dict of scalars.
+        ``token_mask``: see :meth:`_hidden` (padding-vs-routing)."""
+        x, aux_mean = self._hidden(params, token_ids, token_mask)
         return self._logits(x, self._head(params)), aux_mean
 
     # ---- autoregressive decoding ----
@@ -409,10 +426,17 @@ class DMoETransformerLM:
 
         prompt_ids: [B, P] int32 with P + max_new_tokens <= seq_len.
         Returns [B, P + max_new_tokens].  Each step re-runs the full
-        forward over the fixed-length buffer (static shapes for XLA;
-        causality makes the right-padding inert) — the straightforward
-        eval path, not a KV-cache serving stack.  Routing follows
-        :meth:`decode_model` (token-choice, no jitter).
+        forward over the fixed-length buffer (static shapes for XLA) —
+        the straightforward eval path, not a KV-cache serving stack.
+        Routing follows :meth:`decode_model` (token-choice, no jitter).
+
+        Right-padding is masked out of MoE routing via ``token_mask``:
+        causality makes padding inert for *attention*, but capacity
+        routing is cross-token (slot claims are token-order over the
+        flattened [B*S] buffer), so unmasked padding from earlier rows
+        could exhaust expert capacity ahead of later rows' real tokens
+        and decode output would silently depend on padding occupancy
+        (round-3 advisor finding).
         """
         b, p = prompt_ids.shape
         s = self.cfg.seq_len
@@ -432,7 +456,12 @@ class DMoETransformerLM:
 
         def step(carry, t):
             buf, rng = carry
-            logits, _ = model.apply(params, buf)
+            # positions <= t hold real tokens this step; the rest is
+            # padding and must not compete for expert capacity
+            valid = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32)[None, :] <= t, buf.shape
+            )
+            logits, _ = model.apply(params, buf, token_mask=valid)
             step_logits = jax.lax.dynamic_index_in_dim(
                 logits, t, axis=1, keepdims=False
             )  # [B, V]

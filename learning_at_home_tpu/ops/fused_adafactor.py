@@ -117,7 +117,12 @@ def fused_adafactor(
 
     def _transform(grads, state, params, apply: bool):
         if params is None:
-            raise ValueError(optax.NO_PARAMS_MSG)
+            # literal message: optax 0.2.6 exposes no NO_PARAMS_MSG symbol
+            raise ValueError(
+                "You are using a transformation that requires the current "
+                "value of parameters, but you are not passing `params` when "
+                "calling `update`."
+            )
         step = state.count
         # optax's _decay_rate_pow(step - offset): 1 - (t+1)^-decay_rate
         t = (step - decay_offset + 1).astype(jnp.float32)

@@ -70,29 +70,49 @@ def choose_dispatch_impl(n_tokens: int, n_slots: int) -> str:
     return "onehot" if harmonic < 4000 else "gather"
 
 
-def _expert_positions(top_i: jax.Array, num_experts: int) -> jax.Array:
+def _expert_positions(
+    top_i: jax.Array, num_experts: int, valid: jax.Array | None = None
+) -> jax.Array:
     """Slot position of each (token, choice) within its chosen expert.
 
     Token-order claims, counts carried across the k choices — THE slot
     assignment both gating implementations share (identical by
     construction, asserted by tests).  [n, k] int32.
+
+    ``valid`` [n] bool: tokens marked False claim NO slots (their onehot
+    rows are zeroed, so they neither occupy capacity nor advance the
+    counts) — the batched-decode padding fix: a row's right-padding must
+    not exhaust expert capacity ahead of later rows' real tokens.  Their
+    own reported position is 0; callers must AND ``valid`` into ``fits``.
     """
     n, k = top_i.shape
     counts = jnp.zeros((num_experts,), jnp.int32)
     cols = []
     for j in range(k):  # k is small and static — unrolled at trace time
         onehot = jax.nn.one_hot(top_i[:, j], num_experts, dtype=jnp.int32)
+        if valid is not None:
+            onehot = onehot * valid.astype(jnp.int32)[:, None]
         pos_in_expert = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]
         cols.append(jnp.sum(pos_in_expert * onehot, axis=1))
         counts = counts + jnp.sum(onehot, axis=0, dtype=jnp.int32)
     return jnp.stack(cols, axis=1)
 
 
-def _load_balance_loss(gates: jax.Array, top_i: jax.Array) -> jax.Array:
-    """Shazeer/GShard auxiliary: E * <importance> . <top-1 load>."""
+def _load_balance_loss(
+    gates: jax.Array, top_i: jax.Array, valid: jax.Array | None = None
+) -> jax.Array:
+    """Shazeer/GShard auxiliary: E * <importance> . <top-1 load>.
+    ``valid`` restricts both statistics to real (non-padding) tokens."""
     num_experts = gates.shape[1]
-    importance = gates.mean(axis=0)
-    load = jax.nn.one_hot(top_i[:, 0], num_experts, dtype=gates.dtype).mean(axis=0)
+    load_oh = jax.nn.one_hot(top_i[:, 0], num_experts, dtype=gates.dtype)
+    if valid is None:
+        importance = gates.mean(axis=0)
+        load = load_oh.mean(axis=0)
+    else:
+        v = valid.astype(gates.dtype)[:, None]
+        denom = jnp.maximum(v.sum(), 1.0)
+        importance = (gates * v).sum(axis=0) / denom
+        load = (load_oh * v).sum(axis=0) / denom
     return num_experts * jnp.sum(importance * load)
 
 
@@ -133,6 +153,11 @@ _SMALL_TOPK_MAX_K = 4
 
 
 def _top_k(x: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """NOT a general ``lax.top_k`` drop-in: for k <= _SMALL_TOPK_MAX_K
+    inputs must not contain ``finfo.min`` (it collides with the argmax
+    mask sentinel and can duplicate indices — see ``_small_top_k``).
+    Every call site here feeds softmax gates, which are strictly
+    positive; pre-masked logits must use ``jax.lax.top_k`` directly."""
     if k <= _SMALL_TOPK_MAX_K:
         return _small_top_k(x, k)
     return jax.lax.top_k(x, k)
@@ -190,9 +215,24 @@ def router_jitter(
     return gates * noise
 
 
+def _mask_fits(
+    fits: jax.Array, token_mask: jax.Array | None, n: int, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Apply the padding mask to the slot-fit matrix and return it with
+    the dropped-fraction denominator (real routable choices) — the one
+    place both gating forms share this logic, so they cannot drift."""
+    if token_mask is None:
+        return fits, jnp.float32(n * k)
+    return (
+        fits & token_mask[:, None],
+        jnp.maximum(token_mask.sum().astype(jnp.float32) * k, 1.0),
+    )
+
+
 def top_k_gating(
     logits: jax.Array, k: int, capacity: int, renormalize: bool = True,
     jitter: float = 0.0, jitter_salt: jax.Array | int = 0,
+    token_mask: jax.Array | None = None,
 ) -> DispatchPlan:
     """Route each token to its top-k experts, bucketed to static capacity.
 
@@ -200,12 +240,16 @@ def top_k_gating(
     order (deterministic); a token whose chosen expert is already full has
     that choice dropped — its combine weight mass is lost, matching the
     reference's drop-straggler semantics rather than re-routing.
+
+    ``token_mask`` [n] bool (optional, traced): False = padding token —
+    routed nowhere, claims no capacity, excluded from the aux loss and the
+    dropped-fraction denominator (the batched-decode fix).
     """
     n, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)  # [n, E]
     top_w, top_i = _topk_weights(gates, k, renormalize, jitter, jitter_salt)
-    pos = _expert_positions(top_i, num_experts)  # [n, k]
-    fits = pos < capacity
+    pos = _expert_positions(top_i, num_experts, token_mask)  # [n, k]
+    fits, n_routable = _mask_fits(pos < capacity, token_mask, n, k)
 
     combine = jnp.zeros((n, num_experts, capacity), gates.dtype)
     dispatch = jnp.zeros((n, num_experts, capacity), bool)
@@ -217,8 +261,8 @@ def top_k_gating(
         combine = combine + top_w[:, j][:, None, None] * mask
         dispatch = dispatch | (mask > 0)
 
-    aux_loss = _load_balance_loss(gates, top_i)
-    dropped = 1.0 - fits.sum().astype(jnp.float32) / (n * k)
+    aux_loss = _load_balance_loss(gates, top_i, token_mask)
+    dropped = 1.0 - fits.sum().astype(jnp.float32) / n_routable
     return DispatchPlan(combine, dispatch, aux_loss, dropped)
 
 
@@ -235,15 +279,17 @@ def combine_outputs(y: jax.Array, plan: DispatchPlan) -> jax.Array:
 def top_k_gating_indices(
     logits: jax.Array, k: int, capacity: int, renormalize: bool = True,
     jitter: float = 0.0, jitter_salt: jax.Array | int = 0,
+    token_mask: jax.Array | None = None,
 ) -> IndexDispatchPlan:
     """Index-form routing: same semantics as :func:`top_k_gating`
-    (token-order slot claims, capacity dropping, renormalized weights)
-    without ever materializing [n, E, C] tensors."""
+    (token-order slot claims, capacity dropping, renormalized weights,
+    optional padding ``token_mask``) without ever materializing [n, E, C]
+    tensors."""
     n, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)
     top_w, top_i = _topk_weights(gates, k, renormalize, jitter, jitter_salt)
-    pos = _expert_positions(top_i, num_experts)  # [n, k]
-    fits = pos < capacity
+    pos = _expert_positions(top_i, num_experts, token_mask)  # [n, k]
+    fits, n_routable = _mask_fits(pos < capacity, token_mask, n, k)
 
     slot_for_token = jnp.where(
         fits, top_i * capacity + pos, -1
@@ -258,8 +304,8 @@ def top_k_gating_indices(
         .reshape(num_experts, capacity)
     )
 
-    aux_loss = _load_balance_loss(gates, top_i)
-    dropped = 1.0 - fits.sum().astype(jnp.float32) / (n * k)
+    aux_loss = _load_balance_loss(gates, top_i, token_mask)
+    dropped = 1.0 - fits.sum().astype(jnp.float32) / n_routable
     return IndexDispatchPlan(token_for_slot, slot_for_token, weights, aux_loss, dropped)
 
 
@@ -300,13 +346,20 @@ class ExpertChoicePlan(NamedTuple):
     uncovered_fraction: jax.Array  # [] fraction of tokens picked by no expert
 
 
-def expert_choice_gating(logits: jax.Array, capacity: int) -> ExpertChoicePlan:
+def expert_choice_gating(
+    logits: jax.Array, capacity: int, token_mask: jax.Array | None = None
+) -> ExpertChoicePlan:
     """Each expert selects its top-``capacity`` tokens by gate affinity.
 
     logits: [n, E].  Affinity is the token's softmax-over-experts mass on
     this expert (the expert-choice paper's S = softmax(X·Wg, experts),
     selection per expert over tokens).  Average experts-per-token =
     E*C/n, the analogue of token-choice k.
+
+    ``token_mask`` [n] bool: padding tokens sort behind every real token
+    (affinity forced to -1 < 0 < softmax mass) and any that still get
+    picked — possible only when capacity exceeds the real-token count —
+    carry weight 0, so they never perturb real outputs.
 
     NB (documented property, not a bug): selection for token i depends on
     the OTHER tokens in the shard — for causal LM training this leaks a
@@ -320,11 +373,19 @@ def expert_choice_gating(logits: jax.Array, capacity: int) -> ExpertChoicePlan:
     capacity = min(capacity, n)
     gates = jax.nn.softmax(logits, axis=-1)  # [n, E] over experts
     aff = gates.T  # [E, n]
+    if token_mask is not None:
+        aff = jnp.where(token_mask[None, :], aff, -1.0)
     top_w, top_i = jax.lax.top_k(aff, capacity)  # per expert
+    if token_mask is not None:
+        top_w = jnp.maximum(top_w, 0.0)  # picked padding → zero weight
     covered = (
         jnp.zeros((n,), jnp.int32).at[top_i.reshape(-1)].add(1, mode="drop")
     )
-    uncovered = 1.0 - (covered > 0).sum().astype(jnp.float32) / n
+    if token_mask is None:
+        uncovered = 1.0 - (covered > 0).sum().astype(jnp.float32) / n
+    else:
+        real = jnp.maximum(token_mask.sum().astype(jnp.float32), 1.0)
+        uncovered = 1.0 - ((covered > 0) & token_mask).sum() / real
     return ExpertChoicePlan(
         top_i.astype(jnp.int32), top_w, uncovered
     )

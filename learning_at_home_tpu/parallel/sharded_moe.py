@@ -187,12 +187,19 @@ class ShardedMixtureOfExperts:
     def __call__(
         self, params: Params, x: jax.Array,
         jitter_salt: jax.Array | int = 0,
+        token_mask: jax.Array | None = None,
     ) -> tuple[jax.Array, dict]:
         """x: [n_tokens, d] sharded over the data axes.  Returns (y, aux).
 
         ``jitter_salt``: static int or traced scalar (e.g. the layer index
         inside a scan-over-layers) folded into the router-jitter key so
-        each call site draws a decorrelated noise pattern."""
+        each call site draws a decorrelated noise pattern.
+
+        ``token_mask`` [n_tokens] bool (optional, traced): False =
+        padding — routed to no expert, claims no capacity, contributes
+        zero output (the batched-decode fix; see ops.moe_dispatch).  The
+        None path compiles exactly the unmasked program — no masking ops
+        on the training hot path."""
         n_global = x.shape[0]
         n_shards = 1
         for a in self._shard:
@@ -211,25 +218,40 @@ class ShardedMixtureOfExperts:
             # the all_to_all reshapes consistent with the plan shape
             capacity = min(capacity, n_local)
 
+        in_specs = [
+            self.param_specs(),
+            P(self._shard),
+            P(),  # jitter salt: replicated scalar
+        ]
+        out_specs = (
+            P(self._shard),
+            {"aux_loss": P(), "router_z_loss": P(), "dropped_fraction": P()},
+        )
+        if token_mask is None:
+            fn = shard_map(
+                functools.partial(self._local_forward, capacity=capacity),
+                mesh=self.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+            return fn(params, x, jnp.asarray(jitter_salt, jnp.int32))
         fn = shard_map(
-            functools.partial(self._local_forward, capacity=capacity),
+            lambda p, xx, s, m: self._local_forward(
+                p, xx, s, capacity=capacity, token_mask=m
+            ),
             mesh=self.mesh,
-            in_specs=(
-                self.param_specs(),
-                P(self._shard),
-                P(),  # jitter salt: replicated scalar
-            ),
-            out_specs=(
-                P(self._shard),
-                {"aux_loss": P(), "router_z_loss": P(), "dropped_fraction": P()},
-            ),
+            in_specs=tuple(in_specs) + (P(self._shard),),
+            out_specs=out_specs,
             check_vma=False,
         )
-        return fn(params, x, jnp.asarray(jitter_salt, jnp.int32))
+        return fn(
+            params, x, jnp.asarray(jitter_salt, jnp.int32), token_mask
+        )
 
     def _local_forward(
         self, params: Params, x: jax.Array, jitter_salt: jax.Array,
-        capacity: int,
+        capacity: int, token_mask: jax.Array | None = None,
     ) -> tuple[jax.Array, dict]:
         e_local = self.num_experts // self.ep
         d = self.hidden_dim
@@ -246,18 +268,18 @@ class ShardedMixtureOfExperts:
             jnp.float32
         )
         if self.gating == "expert_choice":
-            plan = expert_choice_gating(logits, capacity)
+            plan = expert_choice_gating(logits, capacity, token_mask)
             x_send = dispatch_tokens_expert_choice(x.astype(compute), plan)
         elif impl == "gather":
             plan = top_k_gating_indices(
                 logits, self.k, capacity, jitter=self.router_jitter,
-                jitter_salt=jitter_salt,
+                jitter_salt=jitter_salt, token_mask=token_mask,
             )
             x_send = dispatch_tokens_indexed(x.astype(compute), plan)
         else:
             plan = top_k_gating(
                 logits, self.k, capacity, jitter=self.router_jitter,
-                jitter_salt=jitter_salt,
+                jitter_salt=jitter_salt, token_mask=token_mask,
             )
             x_send = dispatch_tokens(x.astype(compute), plan)  # [E, C, d]
         x_send = x_send.reshape(self.ep, e_local, capacity, d)
@@ -298,8 +320,13 @@ class ShardedMixtureOfExperts:
 
         axes = self._shard
         # router z-loss (ST-MoE): penalizes logit magnitude so the softmax
-        # stays in a well-conditioned regime at scale
-        router_z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+        # stays in a well-conditioned regime at scale (real tokens only)
+        lse2 = jax.scipy.special.logsumexp(logits, axis=-1) ** 2
+        if token_mask is None:
+            router_z = jnp.mean(lse2)
+        else:
+            v = token_mask.astype(lse2.dtype)
+            router_z = (lse2 * v).sum() / jnp.maximum(v.sum(), 1.0)
         if self.gating == "expert_choice":
             # perfectly balanced by construction: no balance auxiliary;
             # "dropped_fraction" reports tokens selected by NO expert

@@ -365,3 +365,69 @@ def test_small_top_k_matches_lax_top_k():
     np.testing.assert_array_equal(np.asarray(i), [[1, 2]])
     with pytest.raises(ValueError):
         _small_top_k(t, 5)
+
+
+class TestTokenMask:
+    """Padding tokens masked out of routing (round-3 advisor: batched
+    decode padding must not exhaust expert capacity ahead of real
+    tokens)."""
+
+    def test_masked_tokens_claim_no_capacity(self):
+        # every token wants expert 0; capacity 2.  Unmasked, tokens 0-1
+        # fill the slots and token 3 is dropped; with tokens 1-2 masked
+        # as padding, token 3 (real) must get a slot instead.
+        logits = jnp.asarray(
+            np.tile([5.0, 0.0, 0.0, 0.0], (4, 1)), jnp.float32
+        )
+        mask = jnp.asarray([True, False, False, True])
+        unmasked = top_k_gating(logits, k=1, capacity=2)
+        assert float(unmasked.combine[3].sum()) == 0.0  # dropped
+        masked = top_k_gating(logits, k=1, capacity=2, token_mask=mask)
+        assert float(masked.combine[3].sum()) > 0.0  # real token fits
+        # padding rows contribute nothing and occupy nothing
+        assert float(masked.combine[1].sum()) == 0.0
+        assert float(masked.combine[2].sum()) == 0.0
+        assert not bool(masked.dispatch[1].any())
+        assert not bool(masked.dispatch[2].any())
+        # dropped_fraction counts only real tokens: both fit -> 0
+        assert float(masked.dropped_fraction) == 0.0
+
+    def test_indexed_plan_matches_onehot_with_mask(self):
+        from learning_at_home_tpu.ops import (
+            combine_outputs_indexed,
+            dispatch_tokens_indexed,
+            top_k_gating_indices,
+        )
+
+        rs = np.random.RandomState(3)
+        logits = jnp.asarray(rs.randn(24, 6).astype(np.float32))
+        mask = jnp.asarray(rs.rand(24) > 0.3)
+        x = jnp.asarray(rs.randn(24, 8).astype(np.float32))
+        p1 = top_k_gating(logits, k=2, capacity=4, token_mask=mask)
+        p2 = top_k_gating_indices(logits, k=2, capacity=4, token_mask=mask)
+        y1 = combine_outputs(dispatch_tokens(x, p1), p1)
+        y2 = combine_outputs_indexed(dispatch_tokens_indexed(x, p2), p2)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+        np.testing.assert_allclose(
+            float(p1.dropped_fraction), float(p2.dropped_fraction), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(p1.aux_loss), float(p2.aux_loss), atol=1e-5
+        )
+
+    def test_expert_choice_mask_zero_weight_padding(self):
+        from learning_at_home_tpu.ops.moe_dispatch import (
+            expert_choice_gating,
+        )
+
+        rs = np.random.RandomState(0)
+        logits = jnp.asarray(rs.randn(4, 2).astype(np.float32))
+        mask = jnp.asarray([True, True, False, False])
+        # capacity 3 > 2 real tokens: experts must pick real tokens first
+        # and any padding picks carry zero weight
+        plan = expert_choice_gating(logits, capacity=3, token_mask=mask)
+        w = np.asarray(plan.weights)
+        t = np.asarray(plan.token_for_slot)
+        assert (w[t >= 2] == 0.0).all()  # padding tokens weightless
+        # both real tokens are covered -> uncovered (over real) == 0
+        assert float(plan.uncovered_fraction) == 0.0

@@ -473,3 +473,41 @@ def test_expert_choice_decode_falls_back_to_token_choice(caplog):
     cfg_j = dataclasses.replace(base, router_jitter=0.2)
     dm_j = DMoETransformerLM(cfg_j, mesh).decode_model()
     assert dm_j.cfg.router_jitter == 0.0
+
+
+def test_padding_content_cannot_leak_into_decode_logits():
+    """Round-3 advisor (medium): MoE capacity routing is cross-token, so
+    with batch > 1 a row's padding tokens could exhaust expert capacity
+    ahead of later rows' real tokens.  With token_mask, valid-position
+    logits must be bit-independent of what the padding buffer holds."""
+    # single-device mesh: the whole [B*S] buffer is ONE token shard, so
+    # row 0's padding precedes row 1's real tokens in slot-claim order —
+    # exactly the single-chip decode layout where the bug bites
+    mesh = make_mesh({"expert": 1}, devices=jax.devices()[:1])
+    cfg = DMoETransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, seq_len=16,
+        num_experts=8, k=2, dtype=jnp.float32,
+        capacity_factor=0.5,  # tight capacity: padding CAN evict real tokens
+    )
+    model = DMoETransformerLM(cfg, mesh)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    p = 4  # real prompt length; the rest of the buffer is padding
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 64, (2, p))
+    pad_a = np.zeros((2, 16 - p), np.int64)
+    pad_b = rs.randint(0, 64, (2, 16 - p))
+    ids_a = jnp.asarray(np.concatenate([prompt, pad_a], axis=1))
+    ids_b = jnp.asarray(np.concatenate([prompt, pad_b], axis=1))
+    mask = jnp.asarray(np.arange(16)[None, :] < p).repeat(2, axis=0)
+
+    la, _ = model.apply(params, ids_a, token_mask=mask)
+    lb, _ = model.apply(params, ids_b, token_mask=mask)
+    np.testing.assert_array_equal(
+        np.asarray(la[:, :p]), np.asarray(lb[:, :p])
+    )
+    # sanity: WITHOUT the mask the tight capacity makes valid logits
+    # depend on padding occupancy — the bug the mask exists to fix
+    ua, _ = model.apply(params, ids_a)
+    ub, _ = model.apply(params, ids_b)
+    assert not np.array_equal(np.asarray(ua[:, :p]), np.asarray(ub[:, :p]))
