@@ -51,6 +51,10 @@ def parse_args():
                         "(PipelinedSwarmTrainer; 1 = sequential). Overlaps "
                         "each step's RPC quorum waits with the next step's "
                         "trunk compute — delayed parameter updates.")
+    p.add_argument("--chaos-bandwidth", type=float, default=0.0,
+                   help="swarm mode: emulated server link bandwidth in "
+                        "bytes/sec (0 = unlimited) — loopback hides "
+                        "payload-size costs without it")
     p.add_argument("--chaos-latency", type=float, default=0.0,
                    help="swarm + --subprocess-servers: inject WAN-like "
                         "latency (s) on every server reply")
@@ -83,6 +87,11 @@ def parse_args():
         "expert-choice (each expert picks top-C tokens; balanced by "
         "construction, no jitter/aux needed)",
     )
+    p.add_argument("--wire-dtype", default=None,
+                   choices=["bfloat16", "float16"],
+                   help="swarm mode: downcast activation/grad RPC payloads "
+                        "on the wire (servers still compute in f32) — "
+                        "halves DCN bytes per dispatch")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
                    help="trainer-side checkpoints (pod and swarm modes)")
@@ -189,6 +198,23 @@ def run_pod(args):
 def run_swarm(args):
     import signal
 
+    # The swarm trainer REQUIRES host callbacks (io_callback under
+    # custom_vjp), which the axon TPU plugin does not implement — and when
+    # the axon relay is down, merely initializing that backend hangs
+    # forever (zero CPU, no error).  Pin CPU before the first device op
+    # ONLY when the ambient environment would resolve to axon (explicitly,
+    # or implicitly via the axon sitecustomize's pool marker); CUDA/other
+    # backends support callbacks and keep their auto-selection.  Pod mode
+    # is the TPU path.
+    amb = os.environ.get("JAX_PLATFORMS", "")
+    if amb == "axon" or (not amb and os.environ.get("PALLAS_AXON_POOL_IPS")):
+        import jax as _jax_cfg
+
+        _jax_cfg.config.update("jax_platforms", "cpu")
+        print("# swarm mode: pinned JAX to cpu (the axon plugin lacks the "
+              "host callbacks this path needs; pass JAX_PLATFORMS=cuda etc. "
+              "to override)", flush=True)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -259,6 +285,11 @@ def run_swarm(args):
                         ["--chaos-latency", str(args.chaos_latency)]
                         if args.chaos_latency
                         else []
+                    )
+                    + (
+                        ["--chaos-bandwidth", str(args.chaos_bandwidth)]
+                        if args.chaos_bandwidth
+                        else []
                     ),
                     env=env,
                 )
@@ -315,6 +346,7 @@ def run_swarm(args):
         seq_len=args.seq_len,
         grid_size=grid,
         k_best=args.k,
+        wire_dtype=args.wire_dtype,
     )
     model = SwarmDMoETransformerLM(cfg, client_dht)
     params = model.init_params(jax.random.PRNGKey(args.seed))

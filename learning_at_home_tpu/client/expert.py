@@ -51,10 +51,19 @@ class RemoteExpert:
         endpoint: Endpoint,
         timeout: float = 30.0,
         output_spec_fn: Optional[Callable] = None,
+        wire_dtype: Optional[str] = None,
     ):
         from learning_at_home_tpu.client.rpc import ensure_sync_cpu_dispatch
 
         ensure_sync_cpu_dispatch()  # host-callback path: see rpc.py
+        from learning_at_home_tpu.utils.serialization import validate_wire_dtype
+
+        validate_wire_dtype(wire_dtype)
+        # transport encoding: floating payloads downcast both ways (server
+        # computes in f32 — see server/connection_handler.py).  NB
+        # forward_blocking/backward_blocking then RETURN wire-dtype arrays;
+        # the jit path upcasts them to the output specs' dtype.
+        self.wire_dtype = wire_dtype
         self.uid = uid
         self.endpoint = (endpoint[0], int(endpoint[1]))
         self.timeout = timeout
@@ -69,9 +78,22 @@ class RemoteExpert:
         pool = pool_registry().get(self.endpoint)
         return await pool.rpc(msg_type, tensors, meta, timeout=self.timeout)
 
+    def _wire_cast(self, arrs) -> list:
+        from learning_at_home_tpu.utils.serialization import wire_cast
+
+        return wire_cast(arrs, self.wire_dtype)
+
+    def _wire_meta(self, meta: dict) -> dict:
+        if self.wire_dtype is not None:
+            meta["wire"] = self.wire_dtype
+        return meta
+
     def forward_blocking(self, inputs: Sequence[np.ndarray]) -> list[np.ndarray]:
         tensors, _ = client_loop().run(
-            self._rpc("forward", inputs, {"uid": self.uid})
+            self._rpc(
+                "forward", self._wire_cast(inputs),
+                self._wire_meta({"uid": self.uid}),
+            )
         )
         return tensors
 
@@ -81,8 +103,8 @@ class RemoteExpert:
         tensors, _ = client_loop().run(
             self._rpc(
                 "backward",
-                [*inputs, *grad_outputs],
-                {"uid": self.uid, "n_inputs": len(inputs)},
+                self._wire_cast([*inputs, *grad_outputs]),
+                self._wire_meta({"uid": self.uid, "n_inputs": len(inputs)}),
             )
         )
         return tensors

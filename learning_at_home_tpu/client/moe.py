@@ -98,9 +98,13 @@ class RemoteMixtureOfExperts:
         routing: str = "enumerate",
         beam_size: int = 8,
         merge_rpcs: bool = True,
+        wire_dtype: Optional[str] = None,
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
+        from learning_at_home_tpu.utils.serialization import validate_wire_dtype
+
+        validate_wire_dtype(wire_dtype)
         from learning_at_home_tpu.client.rpc import ensure_sync_cpu_dispatch
 
         ensure_sync_cpu_dispatch()  # host-callback path: see rpc.py
@@ -119,6 +123,13 @@ class RemoteMixtureOfExperts:
         # one 'multi' request per peer (overhead per PEER not per expert);
         # False restores the reference's strictly per-expert fan-out
         self.merge_rpcs = merge_rpcs
+        # transport encoding for activation/grad payloads ("bfloat16" or
+        # "float16"): floating tensors are downcast on the wire BOTH ways
+        # (the server upcasts to f32 for compute and downcasts its reply —
+        # see server/connection_handler.py).  Halves the payload of the
+        # large-row swarm dispatches that dominate dispatch p50; math
+        # still runs f32 on both ends.  None = uncompressed f32.
+        self.wire_dtype = wire_dtype
         self.source = source
         self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
         self._sessions: OrderedDict[int, dict] = OrderedDict()
@@ -428,14 +439,28 @@ class RemoteMixtureOfExperts:
                 (ep, [uid]) for ep, uids in group_list for uid in uids
             ]
 
+        def cast(arr):
+            """Downcast floating payloads to the wire dtype (transport
+            encoding only; replies are upcast back at the accumulation
+            sites via ``np.asarray(reply, dtype)``)."""
+            from learning_at_home_tpu.utils.serialization import wire_cast
+
+            return wire_cast([arr], self.wire_dtype)[0]
+
         async def call_single(endpoint, uid) -> dict:
             job = jobs[uid]
-            payload = [job[1]] if msg_type == "forward" else [job[1], job[4]]
+            payload = (
+                [cast(job[1])]
+                if msg_type == "forward"
+                else [cast(job[1]), cast(job[4])]
+            )
             meta = (
                 {"uid": uid}
                 if msg_type == "forward"
                 else {"uid": uid, "n_inputs": 1}
             )
+            if self.wire_dtype is not None:
+                meta["wire"] = self.wire_dtype
             tensors, _ = await registry.get(endpoint).rpc(
                 msg_type, payload, meta, timeout=rpc_timeout
             )
@@ -448,14 +473,21 @@ class RemoteMixtureOfExperts:
             parts, payload = [], []
             for uid in uids:
                 job = jobs[uid]
-                t = [job[1]] if msg_type == "forward" else [job[1], job[4]]
+                t = (
+                    [cast(job[1])]
+                    if msg_type == "forward"
+                    else [cast(job[1]), cast(job[4])]
+                )
                 part = {"uid": uid, "n_tensors": len(t)}
                 if msg_type == "backward":
                     part["n_inputs"] = 1
                 parts.append(part)
                 payload.extend(t)
+            multi_meta = {"op": msg_type, "parts": parts}
+            if self.wire_dtype is not None:
+                multi_meta["wire"] = self.wire_dtype
             reply_tensors, reply_meta = await registry.get(endpoint).rpc(
-                "multi", payload, {"op": msg_type, "parts": parts},
+                "multi", payload, multi_meta,
                 timeout=rpc_timeout,
             )
             # reply meta is peer-supplied: any structural lie fails the

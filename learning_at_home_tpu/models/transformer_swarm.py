@@ -54,6 +54,10 @@ class SwarmTransformerConfig:
     forward_timeout: float = 60.0
     backward_timeout: float = 60.0
     timeout_after_k_min: float = 1.0
+    # "bfloat16"/"float16": downcast activation/grad payloads on the wire
+    # (both directions; servers compute in f32) — halves the DCN bytes of
+    # the large-row dispatches that dominate swarm dispatch p50
+    wire_dtype: Any = None
 
 
 class SwarmDMoETransformerLM:
@@ -76,6 +80,7 @@ class SwarmDMoETransformerLM:
                 forward_timeout=config.forward_timeout,
                 backward_timeout=config.backward_timeout,
                 timeout_after_k_min=config.timeout_after_k_min,
+                wire_dtype=config.wire_dtype,
             )
             for i in range(config.n_layers)
         ]

@@ -68,6 +68,9 @@ def main() -> None:
     p.add_argument("--chaos-jitter", type=float, default=0.0)
     p.add_argument("--chaos-straggler-prob", type=float, default=0.0)
     p.add_argument("--chaos-straggler-delay", type=float, default=1.5)
+    p.add_argument("--chaos-bandwidth", type=float, default=0.0,
+                   help="emulated link bandwidth in bytes/sec (0 = "
+                        "unlimited); each reply delayed by payload/bw")
     args = p.parse_args()
 
     import logging
@@ -128,10 +131,11 @@ def main() -> None:
                 jitter=args.chaos_jitter,
                 straggler_prob=args.chaos_straggler_prob,
                 straggler_delay=args.chaos_straggler_delay,
+                bandwidth_bps=args.chaos_bandwidth,
                 seed=args.seed,
             )
             if args.chaos_latency or args.chaos_jitter
-            or args.chaos_straggler_prob
+            or args.chaos_straggler_prob or args.chaos_bandwidth
             else None
         ),
     )

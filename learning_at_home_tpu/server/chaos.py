@@ -25,6 +25,12 @@ class ChaosConfig:
     straggler_prob: float = 0.0  # chance of a long stall instead
     straggler_delay: float = 1.0
     drop_prob: float = 0.0  # chance the reply is never sent
+    # emulated link bandwidth in bytes/sec (0 = unlimited): each reply is
+    # additionally delayed by (request+reply bytes) / bandwidth.  Loopback
+    # moves bytes at memcpy speed, so payload-size effects (and the value
+    # of wire compression — client/moe.py ``wire_dtype``) are invisible
+    # without this; ~12.5e6 (100 Mbit/s) models commodity WAN peers
+    bandwidth_bps: float = 0.0
     seed: Optional[int] = None
 
     def make(self) -> "ChaosInjector":
@@ -39,17 +45,23 @@ class ChaosInjector:
         self.injected_stragglers = 0
         self.injected_drops = 0
 
-    async def before_reply(self) -> bool:
-        """Apply chaos; returns False if the reply must be dropped."""
+    async def before_reply(self, nbytes: int = 0) -> bool:
+        """Apply chaos; returns False if the reply must be dropped.
+        ``nbytes``: request+reply payload size for the bandwidth model."""
         c = self.config
         if c.drop_prob and self.rng.random() < c.drop_prob:
             self.injected_drops += 1
             return False
+        bw_delay = nbytes / c.bandwidth_bps if c.bandwidth_bps else 0.0
         if c.straggler_prob and self.rng.random() < c.straggler_prob:
             self.injected_stragglers += 1
-            await asyncio.sleep(c.straggler_delay)
+            await asyncio.sleep(c.straggler_delay + bw_delay)
             return True
-        delay = c.base_latency + (self.rng.random() * c.jitter if c.jitter else 0.0)
+        delay = (
+            c.base_latency
+            + (self.rng.random() * c.jitter if c.jitter else 0.0)
+            + bw_delay
+        )
         if delay > 0:
             self.injected_delays += 1
             await asyncio.sleep(delay)

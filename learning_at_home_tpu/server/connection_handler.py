@@ -23,6 +23,14 @@ Wire protocol (see utils/serialization.py for framing):
                 PEER, not per expert (failure granularity is per-peer
                 anyway: co-hosted experts die together).
 - errors                                                  → ``error`` meta {message}
+
+Wire compression: a request whose meta carries ``{"wire": "bfloat16"}``
+(or ``"float16"``) declares that its floating tensors were downcast to
+that dtype for transport.  The handler upcasts them to float32 BEFORE the
+task pool (so batches stay one-dtype and each bucket compiles once) and
+downcasts the reply's floating tensors back to the wire dtype.  Halves
+activation/grad bytes on the DCN tier — the 2048-row swarm dispatches are
+payload-bound (BASELINE.md round-2: 300 ms p50).
 """
 
 from __future__ import annotations
@@ -31,17 +39,38 @@ import asyncio
 import logging
 from typing import TYPE_CHECKING
 
+import numpy as np
+
 from learning_at_home_tpu.utils.serialization import (
+    WIRE_DTYPES,
+    is_float_dtype,
     pack_message,
     recv_frame,
     send_frame,
     unpack_message,
+    wire_cast,
 )
 
 if TYPE_CHECKING:
     from learning_at_home_tpu.server.server import Server
 
 logger = logging.getLogger(__name__)
+
+
+def upcast_from_wire(tensors, wire: str | None) -> list:
+    """Wire-compressed floating tensors → float32 compute dtype."""
+    if not wire:
+        return list(tensors)
+    return [
+        t.astype(np.float32)
+        if is_float_dtype(np.asarray(t).dtype) else t
+        for t in tensors
+    ]
+
+
+def downcast_to_wire(tensors, wire: str | None) -> list:
+    """Reply's floating tensors → the requester's wire dtype."""
+    return wire_cast(tensors, wire or None)
 
 
 class ConnectionHandler:
@@ -62,7 +91,9 @@ class ConnectionHandler:
                     break
                 reply = await self._dispatch(payload)
                 if self.server.chaos is not None:
-                    if not await self.server.chaos.before_reply():
+                    if not await self.server.chaos.before_reply(
+                        len(payload) + len(reply)
+                    ):
                         continue  # injected drop: client sees a timeout
                 await send_frame(writer, reply)
         except Exception:
@@ -73,7 +104,7 @@ class ConnectionHandler:
     # ---- per-op execution (validation + pool submit), shared by the
     #      single-expert and multi-expert paths; raises on any failure ----
 
-    async def _run_forward(self, uid: str, tensors) -> list:
+    async def _run_forward(self, uid: str, tensors, wire: str | None = None) -> list:
         backend = self.server.experts.get(uid)
         if backend is None:
             raise ValueError(f"unknown expert uid: {uid!r}")
@@ -85,9 +116,13 @@ class ConnectionHandler:
                 f"expert {uid} takes {backend.n_inputs} inputs, "
                 f"got {len(tensors)}"
             )
-        return await self.server.forward_pools[uid].submit_task(*tensors)
+        tensors = upcast_from_wire(tensors, wire)
+        result = await self.server.forward_pools[uid].submit_task(*tensors)
+        return downcast_to_wire(result, wire)
 
-    async def _run_backward(self, uid: str, tensors, declared_n_inputs) -> list:
+    async def _run_backward(
+        self, uid: str, tensors, declared_n_inputs, wire: str | None = None
+    ) -> list:
         backend = self.server.experts.get(uid)
         if backend is None:
             raise ValueError(f"unknown expert uid: {uid!r}")
@@ -119,7 +154,9 @@ class ConnectionHandler:
                 f"{expected or f'>{backend.n_inputs}'} tensors "
                 f"(inputs + grad_outputs), got {len(tensors)}"
             )
-        return await self.server.backward_pools[uid].submit_task(*tensors)
+        tensors = upcast_from_wire(tensors, wire)
+        result = await self.server.backward_pools[uid].submit_task(*tensors)
+        return downcast_to_wire(result, wire)
 
     async def _run_multi(self, tensors, meta) -> bytes:
         """Fan a merged request out to the local expert pools concurrently;
@@ -127,6 +164,7 @@ class ConnectionHandler:
         error.  All meta is peer-supplied — validate structurally."""
         op = meta.get("op")
         parts = meta.get("parts")
+        wire = meta.get("wire")
         if op not in ("forward", "backward") or not isinstance(parts, list):
             raise ValueError("multi needs op forward|backward and parts list")
         slices = []
@@ -147,8 +185,10 @@ class ConnectionHandler:
         async def run_part(part, part_tensors):
             uid = part.get("uid")
             if op == "forward":
-                return await self._run_forward(uid, part_tensors)
-            return await self._run_backward(uid, part_tensors, part.get("n_inputs"))
+                return await self._run_forward(uid, part_tensors, wire)
+            return await self._run_backward(
+                uid, part_tensors, part.get("n_inputs"), wire
+            )
 
         settled = await asyncio.gather(
             *(run_part(p, t) for p, t in slices), return_exceptions=True
@@ -177,15 +217,24 @@ class ConnectionHandler:
         except Exception as e:
             return pack_message("error", meta={"message": f"malformed request: {e}"})
         uid = meta.get("uid")
+        wire = meta.get("wire")
+        if wire is not None and wire not in WIRE_DTYPES:
+            return pack_message(
+                "error",
+                meta={"message": f"unsupported wire dtype {wire!r}; "
+                      f"supported: {WIRE_DTYPES}"},
+            )
         try:
             if msg_type == "forward":
                 return pack_message(
-                    "result", await self._run_forward(uid, tensors)
+                    "result", await self._run_forward(uid, tensors, wire)
                 )
             elif msg_type == "backward":
                 return pack_message(
                     "result",
-                    await self._run_backward(uid, tensors, meta.get("n_inputs")),
+                    await self._run_backward(
+                        uid, tensors, meta.get("n_inputs"), wire
+                    ),
                 )
             elif msg_type == "multi":
                 return await self._run_multi(tensors, meta)

@@ -231,7 +231,9 @@ class Server:
                 except BaseException:
                     pass  # prior request's failure was already logged
             reply = await handler._dispatch(payload)
-            if self.chaos is not None and not await self.chaos.before_reply():
+            if self.chaos is not None and not await self.chaos.before_reply(
+                len(payload) + len(reply)
+            ):
                 return None  # injected drop: client sees a timeout
             return reply
 

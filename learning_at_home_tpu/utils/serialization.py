@@ -40,6 +40,42 @@ _U32 = struct.Struct("<I")
 # corruption / malicious peers allocating unbounded buffers.
 MAX_FRAME_BYTES = 1 << 30
 
+# Wire-compression dtypes a request may declare via meta {"wire": ...}:
+# floating payloads travel downcast (half the bytes of f32); compute on
+# both ends stays float32.  Transport-level contract, shared by clients
+# (downcast before pack) and the server (upcast after unpack, downcast
+# the reply) — see docs/PROTOCOL.md.
+WIRE_DTYPES = ("bfloat16", "float16")
+
+
+def is_float_dtype(dt) -> bool:
+    """True for ANY floating dtype including ml_dtypes extension types.
+    ``np.issubdtype(np.dtype('bfloat16'), np.floating)`` is False (the
+    extension dtype's kind is 'V'), so numpy's own check silently skips
+    exactly the dtypes wire compression exists for."""
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(np.dtype(dt), jnp.floating)
+
+
+def wire_cast(tensors, wire_dtype: str | None) -> list:
+    """Downcast floating tensors to the wire dtype (no-op when None)."""
+    if wire_dtype is None:
+        return list(tensors)
+    return [
+        np.asarray(t).astype(wire_dtype)
+        if is_float_dtype(np.asarray(t).dtype) else t
+        for t in tensors
+    ]
+
+
+def validate_wire_dtype(wire_dtype: str | None) -> None:
+    if wire_dtype is not None and wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype must be one of {WIRE_DTYPES} or None, "
+            f"got {wire_dtype!r}"
+        )
+
 
 class MSGPackSerializer:
     """msgpack for small control-plane values (DHT records, RPC metadata)."""

@@ -64,3 +64,24 @@ def test_noop_config_is_instant():
         assert inj.injected_delays == inj.injected_drops == 0
 
     run(main())
+
+
+def test_bandwidth_model_delays_proportionally_to_bytes():
+    """bandwidth_bps: reply delayed by nbytes/bw — the knob that makes
+    payload size (and wire compression) visible on loopback."""
+    async def main():
+        inj = ChaosConfig(bandwidth_bps=1e6).make()  # 1 MB/s
+        t0 = time.monotonic()
+        assert await inj.before_reply(nbytes=100_000)  # -> 0.1 s
+        dt_big = time.monotonic() - t0
+        t0 = time.monotonic()
+        assert await inj.before_reply(nbytes=10_000)  # -> 0.01 s
+        dt_small = time.monotonic() - t0
+        assert dt_big >= 0.09
+        assert dt_small < dt_big
+        # nbytes default (0) adds nothing
+        t0 = time.monotonic()
+        assert await inj.before_reply()
+        assert time.monotonic() - t0 < 0.05
+
+    run(main())

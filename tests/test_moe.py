@@ -242,3 +242,57 @@ def test_moe_quorum_failure_raises():
     with pytest.raises(Exception):  # XLA wraps the MoEDispatchError
         np.asarray(moe(jnp.ones((2, HID), jnp.float32), gate))
     reset_client_rpc()
+
+
+class TestWireDtype:
+    """bf16 wire compression (round-3 verdict task 4): payloads downcast
+    on the wire both directions, math still f32 on both ends."""
+
+    def test_forward_parity_and_backward_runs(self, moe_server):
+        endpoint, srv, source = moe_server
+        kw = dict(
+            in_features=HID, grid_size=(4,), uid_prefix="ffn",
+            source=source, k_best=2, k_min=2,
+        )
+        moe32 = RemoteMixtureOfExperts(**kw)
+        moe16 = RemoteMixtureOfExperts(**kw, wire_dtype="bfloat16")
+        gate = moe32.init_gate_params(jax.random.PRNGKey(0))
+        x = np.random.RandomState(3).randn(8, HID).astype(np.float32)
+
+        y32 = np.asarray(moe32(jnp.asarray(x), gate))
+        y16 = np.asarray(moe16(jnp.asarray(x), gate))
+        # bf16 keeps ~8 mantissa bits: outputs agree to bf16 resolution
+        np.testing.assert_allclose(y16, y32, rtol=0.05, atol=0.05)
+        assert not np.allclose(y16, 0.0)
+
+        # backward (fires the server's async optimizer step) must run and
+        # produce finite input-grads through the compressed wire
+        def loss(gate, x):
+            return jnp.sum(moe16(x, gate) ** 2)
+
+        g = jax.grad(loss, argnums=1)(gate, jnp.asarray(x))
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_remote_expert_wire_dtype(self, moe_server):
+        from learning_at_home_tpu.client import RemoteExpert
+
+        endpoint, srv, source = moe_server
+        uid = sorted(srv.experts)[0]
+        e32 = RemoteExpert(uid, endpoint)
+        e16 = RemoteExpert(uid, endpoint, wire_dtype="bfloat16")
+        x = np.random.RandomState(0).randn(4, HID).astype(np.float32)
+        y32 = np.asarray(e32.forward_blocking([x])[0])
+        reply = e16.forward_blocking([x])[0]
+        # server downcasts its reply to the wire dtype
+        assert reply.dtype == np.dtype("bfloat16")
+        np.testing.assert_allclose(
+            np.asarray(reply, np.float32), y32, rtol=0.05, atol=0.05
+        )
+
+    def test_bad_wire_dtype_rejected(self, moe_server):
+        endpoint, srv, source = moe_server
+        with pytest.raises(ValueError, match="wire_dtype"):
+            RemoteMixtureOfExperts(
+                in_features=HID, grid_size=(4,), uid_prefix="ffn",
+                source=source, wire_dtype="float64",
+            )
