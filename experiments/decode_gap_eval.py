@@ -71,9 +71,6 @@ def main() -> None:
     # "held-out" CE would partly measure memorization)
     split = int(0.9 * len(tokens))
     train_tokens, eval_tokens = tokens[:split], tokens[split:]
-    train_batches = LMBatcher(
-        train_tokens, args.batch_size, args.seq_len, seed=args.seed
-    )
     sharding = batch_sharding(mesh)
 
     def make_model(gating: str) -> DMoETransformerLM:
@@ -94,7 +91,12 @@ def main() -> None:
         optimizer = optax.adamw(args.lr)
         opt_state = model.init_opt_state(optimizer, params)
         step_fn = model.make_train_step(optimizer)
-        batches = iter(train_batches)
+        # fresh batcher per run: both gating variants must train on the
+        # SAME batch stream or the control comparison is confounded
+        batches = iter(
+            LMBatcher(train_tokens, args.batch_size, args.seq_len,
+                      seed=args.seed)
+        )
         t0 = time.perf_counter()
         loss = None
         for step in range(args.steps):

@@ -27,6 +27,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from learning_at_home_tpu.models.trunk import (
+    attention_core,
     causal_attention,
     layer_norm,
     output_projection,
@@ -138,6 +139,12 @@ class DMoETransformerLM:
             )
         self.cfg = config
         self.mesh = mesh
+        # compiled decoders (one per decode path) + the memoized
+        # eval-routing twin (see generate / decode_model): without these,
+        # every generate() call re-traces its whole decode loop — measured
+        # 17.1 s vs 0.07 s compiled for 60 tokens at seq_len 1024 on CPU
+        self._gen_jit: dict = {}
+        self._decode_model: "DMoETransformerLM | None" = None
         self.moe = ShardedMixtureOfExperts(
             mesh,
             hidden_dim=config.d_model,
@@ -394,6 +401,9 @@ class DMoETransformerLM:
           decode cannot see (BASELINE.md notes this on the CE-parity row).
         - ``router_jitter``: selection noise is a training-only
           regularizer; decode routes on clean gates.
+
+        Memoized: repeated ``generate()`` calls must reuse the same twin
+        (and hence its compiled-decoder cache).
         """
         cfg = self.cfg
         changed = {}
@@ -412,7 +422,11 @@ class DMoETransformerLM:
             changed["router_jitter"] = 0.0
         if not changed:
             return self
-        return DMoETransformerLM(dataclasses.replace(self.cfg, **changed), self.mesh)
+        if self._decode_model is None:
+            self._decode_model = DMoETransformerLM(
+                dataclasses.replace(self.cfg, **changed), self.mesh
+            )
+        return self._decode_model
 
     def generate(
         self,
@@ -421,6 +435,7 @@ class DMoETransformerLM:
         max_new_tokens: int,
         temperature: float = 0.0,
         rng: jax.Array | None = None,
+        use_cache: bool = False,
     ) -> jax.Array:
         """Greedy (or temperature-sampled) autoregressive decoding.
 
@@ -437,6 +452,14 @@ class DMoETransformerLM:
         could exhaust expert capacity ahead of later rows' real tokens
         and decode output would silently depend on padding occupancy
         (round-3 advisor finding).
+
+        ``use_cache=True`` switches to the incremental KV-cache decoder
+        (:meth:`_generate_cached`): O(S·d) per new token instead of the
+        full O(S²·d) re-forward.  Routing note: each decode step routes
+        only the B live tokens (per-step capacity), whereas the
+        re-forward path routes the whole masked buffer — identical
+        whenever capacity never binds (generous ``capacity_factor``),
+        and the per-step regime is what a serving stack does anyway.
         """
         b, p = prompt_ids.shape
         s = self.cfg.seq_len
@@ -449,10 +472,58 @@ class DMoETransformerLM:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if temperature > 0 and rng is None:
             raise ValueError("temperature > 0 requires an rng key")
+        if use_cache:
+            if self.cfg.seq_parallel:
+                raise NotImplementedError(
+                    "use_cache=True does not compose with seq_parallel "
+                    "(the cache is not ring-sharded); decode on a "
+                    "non-seq-parallel mesh"
+                )
+            from learning_at_home_tpu.parallel.mesh import data_axes
+
+            n_shards = 1
+            for a in data_axes(self.mesh):
+                n_shards *= self.mesh.shape[a]
+            if b % n_shards or (b * p) % n_shards:
+                raise ValueError(
+                    f"use_cache=True routes B={b} rows per decode step and "
+                    f"B*P={b * p} in prefill, which must divide the mesh's "
+                    f"{n_shards} token shards — grow the batch or decode "
+                    "without the cache (the re-forward path routes the "
+                    "whole buffer and is immune)"
+                )
         model = self.decode_model()
-        buf = jnp.zeros((b, s), prompt_ids.dtype).at[:, :p].set(prompt_ids)
+        # one compiled decoder per path, cached on the decode twin; jit's
+        # own shape/static keying handles (b, p, max_new_tokens,
+        # temperature) variation.  Eager tracing of the whole decode loop
+        # cost 17.1 s where the compiled call takes 0.07 s (60 tokens,
+        # seq 1024, CPU).
+        fn = model._gen_jit.get(use_cache)
+        if fn is None:
+            fn = jax.jit(
+                model._generate_cached if use_cache else model._generate_full,
+                static_argnums=(2, 3),  # max_new_tokens, temperature
+            )
+            model._gen_jit[use_cache] = fn
         if rng is None:
             rng = jax.random.PRNGKey(0)  # unused at temperature == 0
+        return fn(params, prompt_ids, max_new_tokens, float(temperature), rng)
+
+    def _generate_full(
+        self,
+        params: Params,
+        prompt_ids: jax.Array,
+        max_new_tokens: int,
+        temperature: float,
+        rng: jax.Array,
+    ) -> jax.Array:
+        """Re-forward decoding: every step runs the full masked forward
+        over the fixed-length buffer.  Simple and exactly the training
+        graph; O(S²·d) per token — prefer ``use_cache=True`` for long
+        buffers."""
+        b, p = prompt_ids.shape
+        s = self.cfg.seq_len
+        buf = jnp.zeros((b, s), prompt_ids.dtype).at[:, :p].set(prompt_ids)
 
         def step(carry, t):
             buf, rng = carry
@@ -461,7 +532,7 @@ class DMoETransformerLM:
             valid = jnp.broadcast_to(
                 jnp.arange(s, dtype=jnp.int32)[None, :] <= t, buf.shape
             )
-            logits, _ = model.apply(params, buf, token_mask=valid)
+            logits, _ = self.apply(params, buf, token_mask=valid)
             step_logits = jax.lax.dynamic_index_in_dim(
                 logits, t, axis=1, keepdims=False
             )  # [B, V]
@@ -471,11 +542,11 @@ class DMoETransformerLM:
             else:
                 nxt = jnp.argmax(step_logits, axis=-1)
             nxt = nxt.astype(buf.dtype)
-            # only write while t is a real decode position (static bound
-            # covers the scan length; writes are always in range here)
-            buf = jax.vmap(
-                lambda row, v, i: jax.lax.dynamic_update_index_in_dim(row, v, i, 0)
-            )(buf, nxt, jnp.full((b,), t + 1))
+            # all rows write the same column t+1 (static bound covers the
+            # scan length; writes are always in range here)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], t + 1, axis=1
+            )
             return (buf, rng), None
 
         (buf, _), _ = jax.lax.scan(
@@ -484,6 +555,136 @@ class DMoETransformerLM:
             jnp.arange(p - 1, p - 1 + max_new_tokens, dtype=jnp.int32),
         )
         return buf[:, : p + max_new_tokens]
+
+    # ---- incremental (KV-cache) decoding ----
+
+    def _layer_params(self, params: Params, i: int):
+        """Layer i's param tree under either layout (stacked / tuple)."""
+        if self.cfg.stack_layers:
+            return jax.tree_util.tree_map(lambda l: l[i], params["layers"])
+        return params["layers"][i]
+
+    @staticmethod
+    def _one_query_attention(
+        lp, q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, t: jax.Array
+    ) -> jax.Array:
+        """Attention for ONE query position over the cache.
+
+        q [B,1,H,hd]; caches [B,S,H,hd] (positions > t are garbage and
+        masked).  f32 softmax, 1/sqrt(hd) scale — the same numerics as
+        ``jax.nn.dot_product_attention`` in the full forward.
+        """
+        hd = q.shape[-1]
+        scores = jnp.einsum(
+            "bqhd,bshd->bhqs", q, k_cache, preferred_element_type=jnp.float32
+        ) * (1.0 / np.sqrt(hd))
+        s = k_cache.shape[1]
+        mask = jnp.arange(s, dtype=jnp.int32)[None, None, None, :] <= t
+        scores = jnp.where(mask, scores, -jnp.inf)
+        w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqs,bshd->bqhd", w, v_cache)
+        return output_projection(lp, out)
+
+    def _generate_cached(
+        self,
+        params: Params,
+        prompt_ids: jax.Array,
+        max_new_tokens: int,
+        temperature: float,
+        rng: jax.Array | None,
+    ) -> jax.Array:
+        """Incremental decode: prefill the KV cache on the prompt, then
+        one O(S·d) step per new token.  Called via
+        ``generate(use_cache=True)`` on the :meth:`decode_model` (this
+        instance already has eval-safe routing)."""
+        cfg = self.cfg
+        b, p = prompt_ids.shape
+        s_cache = p + max_new_tokens
+        hd = cfg.d_model // cfg.n_heads
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # unused at temperature == 0
+
+        def sample(logits_1d, key):  # [B, V] -> [B]
+            if temperature > 0:  # static: resolved at trace time
+                return jax.random.categorical(key, logits_1d / temperature)
+            return jnp.argmax(logits_1d, axis=-1)
+
+        # ---- prefill: full forward over the prompt, caches filled ----
+        x = params["embed"][prompt_ids].astype(cfg.dtype)
+        x = x + params["pos"][None, :p].astype(cfg.dtype)
+        k_caches, v_caches = [], []
+        for i in range(cfg.n_layers):
+            lp = self._layer_params(params, i)
+            h = layer_norm(lp["ln1"], x)
+            q, k, v = qkv_projections(lp, h, cfg.n_heads)
+            # same impl as the full forward: the parity guarantee vs the
+            # re-forward decoder must survive flash-attention configs
+            x = x + output_projection(
+                lp, attention_core(q, k, v, cfg.attn_impl)
+            )
+            moe_in = layer_norm(lp["ln2"], x).reshape(b * p, cfg.d_model)
+            moe_out, _ = self.moe(lp["moe"], moe_in, jitter_salt=i)
+            x = x + moe_out.reshape(b, p, cfg.d_model)
+            kc = jnp.zeros((b, s_cache, cfg.n_heads, hd), k.dtype)
+            vc = jnp.zeros_like(kc)
+            k_caches.append(jax.lax.dynamic_update_slice(kc, k, (0, 0, 0, 0)))
+            v_caches.append(jax.lax.dynamic_update_slice(vc, v, (0, 0, 0, 0)))
+        x_last = layer_norm(params["ln_f"], x[:, -1:])
+        logits = self._logits(x_last, self._head(params))[:, 0]  # [B, V]
+        rng, sub = jax.random.split(rng)
+        next_tok = sample(logits, sub).astype(prompt_ids.dtype)
+
+        out_buf = (
+            jnp.zeros((b, max_new_tokens), prompt_ids.dtype)
+            .at[:, 0].set(next_tok)
+        )
+
+        # ---- decode: one position per step, caches appended in place.
+        # Caches stay a TUPLE of per-layer arrays (scan carry leaves): a
+        # stacked [L, ...] cache would need .at[i].set, which copies the
+        # whole stack per layer per step — measured 2x slower end-to-end.
+        def step(carry, t):
+            k_caches, v_caches, tok, out_buf, rng = carry
+            x = params["embed"][tok].astype(cfg.dtype)  # [B, d]
+            x = x + jnp.take(
+                params["pos"].astype(cfg.dtype), t, axis=0
+            )[None, :]
+            x = x[:, None, :]  # [B, 1, d]
+            k_caches, v_caches = list(k_caches), list(v_caches)
+            for i in range(cfg.n_layers):
+                lp = self._layer_params(params, i)
+                h = layer_norm(lp["ln1"], x)
+                q, k, v = qkv_projections(lp, h, cfg.n_heads)
+                k_caches[i] = jax.lax.dynamic_update_slice(
+                    k_caches[i], k, (0, t, 0, 0)
+                )
+                v_caches[i] = jax.lax.dynamic_update_slice(
+                    v_caches[i], v, (0, t, 0, 0)
+                )
+                x = x + self._one_query_attention(
+                    lp, q, k_caches[i], v_caches[i], t
+                )
+                moe_in = layer_norm(lp["ln2"], x).reshape(b, cfg.d_model)
+                moe_out, _ = self.moe(lp["moe"], moe_in, jitter_salt=i)
+                x = x + moe_out.reshape(b, 1, cfg.d_model)
+            x = layer_norm(params["ln_f"], x)
+            logits = self._logits(x, self._head(params))[:, 0]
+            rng, sub = jax.random.split(rng)
+            nxt = sample(logits, sub).astype(tok.dtype)
+            out_buf = jax.lax.dynamic_update_slice_in_dim(
+                out_buf, nxt[:, None], t - p + 1, axis=1
+            )
+            return (
+                tuple(k_caches), tuple(v_caches), nxt, out_buf, rng
+            ), None
+
+        if max_new_tokens > 1:
+            (_, _, _, out_buf, _), _ = jax.lax.scan(
+                step,
+                (tuple(k_caches), tuple(v_caches), next_tok, out_buf, rng),
+                jnp.arange(p, p + max_new_tokens - 1, dtype=jnp.int32),
+            )
+        return jnp.concatenate([prompt_ids, out_buf], axis=1)
 
     # ---- loss / train step ----
 

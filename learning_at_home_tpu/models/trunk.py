@@ -56,9 +56,18 @@ def causal_attention(
     (parallel/ring_attention.py), which shares :func:`qkv_projections` /
     :func:`output_projection` and replaces only this dense core.
     """
+    q, k, v = qkv_projections(lp, x, n_heads)
+    return output_projection(lp, attention_core(q, k, v, impl))
+
+
+def attention_core(
+    q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "xla"
+) -> jax.Array:
+    """The causal attention math on pre-projected [B,S,H,hd] q/k/v —
+    shared by :func:`causal_attention` and the KV-cache decoder's prefill
+    so the two paths cannot diverge numerically per ``impl``."""
     if impl not in ("xla", "flash"):
         raise ValueError(f"impl must be 'xla' or 'flash', got {impl!r}")
-    q, k, v = qkv_projections(lp, x, n_heads)
     if impl == "flash":
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention,
@@ -66,13 +75,11 @@ def causal_attention(
 
         hd = q.shape[-1]
         # kernel convention is [B, H, S, hd] and applies no scale itself
-        out = flash_attention(
+        return flash_attention(
             q.transpose(0, 2, 1, 3),
             k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3),
             causal=True,
             sm_scale=1.0 / (hd ** 0.5),
         ).transpose(0, 2, 1, 3)
-    else:
-        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
-    return output_projection(lp, out)
+    return jax.nn.dot_product_attention(q, k, v, is_causal=True)
