@@ -463,6 +463,11 @@ class DMoETransformerLM:
         """
         b, p = prompt_ids.shape
         s = self.cfg.seq_len
+        if p == 0:
+            raise ValueError(
+                "prompt must have at least one token (p=0 would wrap the "
+                "first write to the end of the decode buffer)"
+            )
         if p + max_new_tokens > s:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "

@@ -151,3 +151,33 @@ def test_native_transport_pipelined_ordering():
             assert msg_type == "result"
             assert meta["name"] == uid, (meta["name"], uid)
         s.close()
+
+
+def test_native_transport_wire_dtype():
+    """bf16 wire compression through the C++ framepump plane: the native
+    dispatcher routes the same meta, so wire-compressed requests must get
+    wire-compressed replies with f32-grade numerics."""
+    import numpy as np
+    import pytest
+
+    from learning_at_home_tpu.client import RemoteExpert, reset_client_rpc
+    from learning_at_home_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native framepump unavailable (no g++?)")
+
+    rs = np.random.RandomState(1)
+    x = rs.randn(4, 16).astype(np.float32)
+    with background_server(
+        num_experts=1, hidden_dim=16, expert_prefix="nw", seed=5,
+        transport="native",
+    ) as (endpoint, srv):
+        e32 = RemoteExpert("nw.0", endpoint)
+        e16 = RemoteExpert("nw.0", endpoint, wire_dtype="bfloat16")
+        y32 = np.asarray(e32.forward_blocking([x])[0])
+        reply = e16.forward_blocking([x])[0]
+        assert reply.dtype == np.dtype("bfloat16")
+        np.testing.assert_allclose(
+            np.asarray(reply, np.float32), y32, rtol=0.05, atol=0.05
+        )
+    reset_client_rpc()
