@@ -64,6 +64,11 @@ def main() -> None:
     n_dev = len(jax.devices())
     mesh = make_mesh({"expert": n_dev})
     on_tpu = jax.devices()[0].platform != "cpu"
+    if args.batch_size % n_dev:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must divide across the "
+            f"{n_dev} token shards of the expert mesh"
+        )
 
     tokens = load_corpus(args.data, seed=args.seed)
     # train/eval split: DISJOINT stream halves (reseeding the batcher

@@ -93,3 +93,30 @@ def test_train_lm_swarm_subprocess_smoke():
         timeout=420,
     )
     assert lines and all("loss" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_generate_lm_smoke():
+    outs = run_script(
+        ["experiments/generate_lm.py", "--no-checkpoint",
+         "--num-experts", "8", "--d-model", "64", "--seq-len", "64",
+         "--prompt", "ab", "--max-new-tokens", "6", "--bench", "8"],
+        timeout=300,
+    )
+    comp = next(o for o in outs if "completion" in o)
+    bench = next(o for o in outs if "decode_steps_per_sec" in o)
+    assert comp["completion"].startswith("ab")
+    assert bench["decode_steps_per_sec"] > 0 and bench["use_cache"]
+
+
+@pytest.mark.slow
+def test_decode_gap_eval_smoke():
+    (out,) = run_script(
+        ["experiments/decode_gap_eval.py", "--steps", "6",
+         "--eval-batches", "2", "--batch-size", "8", "--seq-len", "32",
+         "--d-model", "32", "--num-experts", "8", "--skip-control"],
+        timeout=300,
+    )
+    assert out["gating"] == "expert_choice"
+    assert out["eval_ce_training_routing"] > 0
+    assert "decode_gap_nats" in out
