@@ -92,6 +92,9 @@ def parse_args():
                    help="swarm mode: downcast activation/grad RPC payloads "
                         "on the wire (servers still compute in f32) — "
                         "halves DCN bytes per dispatch")
+    p.add_argument("--latency-weight", type=float, default=0.0,
+                   help="swarm mode: debit expert selection scores by this "
+                        "x endpoint RTT EMA (s) — route around slow peers")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
                    help="trainer-side checkpoints (pod and swarm modes)")
@@ -347,6 +350,7 @@ def run_swarm(args):
         grid_size=grid,
         k_best=args.k,
         wire_dtype=args.wire_dtype,
+        latency_weight=args.latency_weight,
     )
     model = SwarmDMoETransformerLM(cfg, client_dht)
     params = model.init_params(jax.random.PRNGKey(args.seed))

@@ -99,6 +99,7 @@ class RemoteMixtureOfExperts:
         beam_size: int = 8,
         merge_rpcs: bool = True,
         wire_dtype: Optional[str] = None,
+        latency_weight: float = 0.0,
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
@@ -130,6 +131,16 @@ class RemoteMixtureOfExperts:
         # large-row swarm dispatches that dominate dispatch p50; math
         # still runs f32 on both ends.  None = uncompressed f32.
         self.wire_dtype = wire_dtype
+        # latency-aware SELECTION (topology/load-aware routing, cf. the
+        # TA-MoE / MoETuner line of work): each expert's selection score
+        # is debited latency_weight × its endpoint's RTT EMA (seconds —
+        # network + peer queueing + compute, from ConnectionPool), so
+        # near-tied gate scores resolve toward fast/unloaded peers
+        # PROACTIVELY instead of only dropping stragglers reactively via
+        # the quorum.  Combine weights stay clean-gate (selection-only,
+        # like router jitter).  0.0 = off (exact reference semantics);
+        # gate logits are O(1), so e.g. 5.0 makes 100 ms cost 0.5 logits.
+        self.latency_weight = latency_weight
         self.source = source
         self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
         self._sessions: OrderedDict[int, dict] = OrderedDict()
@@ -268,7 +279,17 @@ class RemoteMixtureOfExperts:
             raise MoEDispatchError(
                 f"no alive experts under prefix {self.uid_prefix!r}"
             )
-        sel, coords = select_top_k(logits, alive_uids, self.k_best)  # [B, k']
+        bias = None
+        if self.latency_weight:
+            registry = pool_registry()
+            bias = np.zeros(len(alive_uids), np.float32)
+            for j, uid in enumerate(alive_uids):
+                pool = registry.peek(alive[uid])  # non-creating: see peek()
+                if pool is not None and pool.rtt_ema is not None:
+                    bias[j] = -self.latency_weight * pool.rtt_ema
+        sel, coords = select_top_k(
+            logits, alive_uids, self.k_best, bias=bias
+        )  # [B, k']
         k_eff = sel.shape[1]
 
         # group rows by chosen expert: expert -> (rows, slots)

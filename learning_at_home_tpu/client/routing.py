@@ -270,6 +270,7 @@ def select_top_k(
     logits_per_dim: Sequence[np.ndarray],
     alive_uids: Sequence[str],
     k: int,
+    bias: Optional[np.ndarray] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Per-sample top-k over all alive experts (full enumeration).
 
@@ -277,12 +278,19 @@ def select_top_k(
     DHT-backed beam search (M2/M4) replaces enumeration when the grid is
     large but only a fraction is alive or local.
     Returns (sel [batch, k] indices into alive_uids, coords [n, n_dims]).
+
+    ``bias`` [len(alive_uids)] (optional): per-expert additive score
+    adjustment applied to SELECTION only — the caller's combine weights
+    still come from the clean gate scores (same selection-vs-weights
+    split as router jitter).  Used for latency-aware routing.
     """
     n_dims = len(logits_per_dim)
     coords = np.asarray(
         [split_uid(uid, n_dims)[1] for uid in alive_uids], dtype=np.int64
     )
     scores = score_experts(logits_per_dim, coords)  # [B, E]
+    if bias is not None:
+        scores = scores + np.asarray(bias, scores.dtype)[None, :]
     n = scores.shape[1]
     k_eff = min(k, n)
     # argpartition then sort the head: O(E + k log k) per sample

@@ -58,6 +58,10 @@ class SwarmTransformerConfig:
     # (both directions; servers compute in f32) — halves the DCN bytes of
     # the large-row dispatches that dominate swarm dispatch p50
     wire_dtype: Any = None
+    # > 0: debit each expert's SELECTION score by this × its endpoint's
+    # RTT EMA (seconds) so routing avoids slow/overloaded peers
+    # proactively (see client/moe.py latency_weight); 0 = off
+    latency_weight: float = 0.0
 
 
 class SwarmDMoETransformerLM:
@@ -81,6 +85,7 @@ class SwarmDMoETransformerLM:
                 backward_timeout=config.backward_timeout,
                 timeout_after_k_min=config.timeout_after_k_min,
                 wire_dtype=config.wire_dtype,
+                latency_weight=config.latency_weight,
             )
             for i in range(config.n_layers)
         ]

@@ -38,6 +38,12 @@ class ConnectionPool:
         self.endpoint = endpoint
         self._free: asyncio.Queue = asyncio.Queue()
         self._sem = asyncio.Semaphore(max_connections)
+        # EMA of successful whole-exchange times (seconds), excluding the
+        # local semaphore wait: covers network RTT AND the peer's queueing
+        # + compute, so it doubles as a load signal.  Consumed by the
+        # MoE's latency-aware expert selection (client/moe.py
+        # ``latency_weight``); None until the first success.
+        self.rtt_ema: Optional[float] = None
 
     async def _acquire(self):
         while not self._free.empty():
@@ -63,24 +69,44 @@ class ConnectionPool:
         with timeline.span(f"rpc.{msg_type}"):
             return await self._rpc_inner(msg_type, tensors, meta, timeout)
 
+    def _update_rtt(self, dt: float) -> None:
+        self.rtt_ema = (
+            dt if self.rtt_ema is None else 0.8 * self.rtt_ema + 0.2 * dt
+        )
+
     async def _rpc_inner(self, msg_type, tensors, meta, timeout):
+        loop = asyncio.get_running_loop()
         async with self._sem:
             writer = None
+            t0 = loop.time()
             try:
                 async with asyncio.timeout(timeout):
                     reader, writer = await self._acquire()
                     await send_frame(writer, pack_message(msg_type, tensors, meta))
                     payload = await recv_frame(reader)
-            except BaseException:
+            except BaseException as e:
                 if writer is not None:
                     writer.close()  # connection state unknown → do not reuse
+                # timeouts and straggler cancels ARE the slowness signal —
+                # fold the elapsed wait into the EMA or peers slower than
+                # the timeout would never be penalized at all.  Fast
+                # failures (refused connection, reset) say nothing about
+                # latency and must NOT reward a broken peer with a small
+                # EMA — skip those.
+                if isinstance(e, (TimeoutError, asyncio.CancelledError)):
+                    self._update_rtt(loop.time() - t0)
                 raise
+            dt = loop.time() - t0
             self._free.put_nowait((reader, writer))
         reply_type, reply_tensors, reply_meta = unpack_message(payload)
         if reply_type == "error":
+            # error replies are typically the FASTEST exchanges (no expert
+            # compute); counting them would steer latency-aware selection
+            # toward broken peers — do not update the EMA
             raise RemoteCallError(
                 f"{self.endpoint}: {reply_meta.get('message', 'unknown error')}"
             )
+        self._update_rtt(dt)
         return reply_tensors, reply_meta
 
     def close(self) -> None:
@@ -101,6 +127,13 @@ class PoolRegistry:
         if endpoint not in self._pools:
             self._pools[endpoint] = ConnectionPool(endpoint, self.max_connections)
         return self._pools[endpoint]
+
+    def peek(self, endpoint: Endpoint) -> Optional[ConnectionPool]:
+        """Non-creating lookup: read-only consumers (latency bias) must
+        not instantiate pools for peers that were never contacted, and a
+        host-thread ``get()`` racing the loop thread's could register two
+        pools for one endpoint (EMA updates landing on the orphan)."""
+        return self._pools.get((endpoint[0], int(endpoint[1])))
 
     def close(self) -> None:
         for pool in self._pools.values():

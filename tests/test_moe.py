@@ -296,3 +296,59 @@ class TestWireDtype:
                 in_features=HID, grid_size=(4,), uid_prefix="ffn",
                 source=source, wire_dtype="float64",
             )
+
+
+def test_select_top_k_bias_steers_selection():
+    """Selection bias (latency-aware routing) flips near-ties without
+    touching the caller's score space."""
+    logits = [np.tile([0.2, 0.0, 0.0, 0.5], (3, 1)).astype(np.float32)]
+    uids = [make_uid("b", (i,)) for i in range(4)]
+    sel0, _ = select_top_k(logits, uids, k=1)
+    assert (sel0 == 3).all()  # best gate score wins unbiased
+    bias = np.asarray([0.0, 0.0, 0.0, -1.0], np.float32)  # slow peer
+    sel, _ = select_top_k(logits, uids, k=1, bias=bias)
+    assert (sel == 0).all()  # the penalty outweighs the 0.3 gate edge
+
+
+class TestLatencyAwareRouting:
+    """latency_weight: selection learns to avoid a slow peer (cf. the
+    topology-/placement-aware MoE serving literature)."""
+
+    def _run(self, latency_weight: float) -> list:
+        from learning_at_home_tpu.server import ChaosConfig
+
+        slow_chaos = ChaosConfig(base_latency=0.25, seed=0)
+        with background_server(
+            num_experts=2, hidden_dim=HID, expert_prefix="lat", seed=1
+        ) as (fast_ep, fast_srv):
+            with background_server(
+                num_experts=2, hidden_dim=HID, expert_prefix="lat",
+                expert_offset=2, seed=2, chaos=slow_chaos,
+            ) as (slow_ep, slow_srv):
+                experts = {uid: fast_ep for uid in fast_srv.experts}
+                experts.update({uid: slow_ep for uid in slow_srv.experts})
+                moe = RemoteMixtureOfExperts(
+                    in_features=HID, grid_size=(4,), uid_prefix="lat",
+                    source=StaticExpertSource(experts), k_best=2, k_min=1,
+                    timeout_after_k_min=2.0,
+                    latency_weight=latency_weight,
+                )
+                gate = moe.init_gate_params(jax.random.PRNGKey(0))
+                rs = np.random.RandomState(0)
+                for _ in range(8):
+                    x = jnp.asarray(rs.randn(6, HID).astype(np.float32))
+                    moe(x, gate)
+                times = list(moe.dispatch_times)
+        reset_client_rpc()
+        return times
+
+    def test_latency_weight_learns_to_avoid_slow_peer(self):
+        aware = self._run(latency_weight=20.0)
+        # first dispatches probe both peers (EMA warmup); once the slow
+        # peer's ~0.25 s EMA is learned, its selection score drops by ~5
+        # and later dispatches route around it entirely
+        assert np.mean(aware[-3:]) < 0.2, aware
+        # control: same topology, no bias — the slow peer keeps being
+        # picked and late dispatches still pay its injected latency
+        blind = self._run(latency_weight=0.0)
+        assert np.mean(blind[-3:]) > 0.2, blind
