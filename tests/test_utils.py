@@ -180,3 +180,84 @@ def test_unpack_fuzz_never_hangs_or_corrupts():
         for a, b in zip(tensors, tensors2):
             assert a.dtype == b.dtype and a.shape == b.shape
             np.testing.assert_array_equal(a, b)
+
+
+class TestRttEma:
+    """Latency-EMA hygiene (round-4 review): the signal that drives
+    latency-aware routing must not be poisoned by fast failures."""
+
+    def _run(self, coro):
+        import asyncio
+
+        return asyncio.run(coro)
+
+    def test_error_replies_do_not_update_ema(self):
+        """Error exchanges are typically the fastest (no expert compute);
+        counting them would steer selection TOWARD broken peers."""
+        import asyncio
+
+        from learning_at_home_tpu.utils.connection import (
+            ConnectionPool,
+            RemoteCallError,
+        )
+        from learning_at_home_tpu.utils.serialization import (
+            pack_message,
+            recv_frame,
+            send_frame,
+        )
+
+        async def main():
+            async def handler(reader, writer):
+                while True:
+                    try:
+                        await recv_frame(reader)
+                    except Exception:
+                        break
+                    await send_frame(
+                        writer, pack_message("error", meta={"message": "boom"})
+                    )
+
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            ep = server.sockets[0].getsockname()[:2]
+            pool = ConnectionPool(ep)
+            for _ in range(3):
+                try:
+                    await pool.rpc("forward", (), {"uid": "x"}, timeout=5)
+                except RemoteCallError:
+                    pass
+            assert pool.rtt_ema is None  # errors never counted
+            pool.close()
+            server.close()
+
+        self._run(main())
+
+    def test_timeout_folds_elapsed_into_ema(self):
+        """Peers slower than the timeout must still be penalized — the
+        whole point of the latency bias."""
+        import asyncio
+
+        from learning_at_home_tpu.utils.connection import ConnectionPool
+
+        async def main():
+            async def handler(reader, writer):
+                await asyncio.sleep(30)  # black hole: never reply
+
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            ep = server.sockets[0].getsockname()[:2]
+            pool = ConnectionPool(ep)
+            with pytest.raises(TimeoutError):
+                await pool.rpc("forward", (), {"uid": "x"}, timeout=0.3)
+            assert pool.rtt_ema is not None and pool.rtt_ema >= 0.25
+            pool.close()
+            server.close()
+
+        self._run(main())
+
+    def test_registry_peek_is_non_creating(self):
+        from learning_at_home_tpu.utils.connection import PoolRegistry
+
+        reg = PoolRegistry()
+        assert reg.peek(("127.0.0.1", 1)) is None
+        assert len(reg._pools) == 0  # peek must not register pools
+        pool = reg.get(("127.0.0.1", 1))
+        assert reg.peek(("127.0.0.1", 1)) is pool
