@@ -21,6 +21,11 @@ from learning_at_home_tpu.utils.serialization import (
     send_frame,
     unpack_message,
 )
+from learning_at_home_tpu.utils.connection import (
+    ConnectionPool,
+    PoolRegistry,
+    RemoteCallError,
+)
 from learning_at_home_tpu.utils.timed_storage import TimedStorage, get_dht_time
 
 
@@ -187,24 +192,11 @@ class TestRttEma:
     latency-aware routing must not be poisoned by fast failures."""
 
     def _run(self, coro):
-        import asyncio
-
         return asyncio.run(coro)
 
     def test_error_replies_do_not_update_ema(self):
         """Error exchanges are typically the fastest (no expert compute);
         counting them would steer selection TOWARD broken peers."""
-        import asyncio
-
-        from learning_at_home_tpu.utils.connection import (
-            ConnectionPool,
-            RemoteCallError,
-        )
-        from learning_at_home_tpu.utils.serialization import (
-            pack_message,
-            recv_frame,
-            send_frame,
-        )
 
         async def main():
             async def handler(reader, writer):
@@ -234,9 +226,6 @@ class TestRttEma:
     def test_timeout_folds_elapsed_into_ema(self):
         """Peers slower than the timeout must still be penalized — the
         whole point of the latency bias."""
-        import asyncio
-
-        from learning_at_home_tpu.utils.connection import ConnectionPool
 
         async def main():
             async def handler(reader, writer):
@@ -254,8 +243,6 @@ class TestRttEma:
         self._run(main())
 
     def test_registry_peek_is_non_creating(self):
-        from learning_at_home_tpu.utils.connection import PoolRegistry
-
         reg = PoolRegistry()
         assert reg.peek(("127.0.0.1", 1)) is None
         assert len(reg._pools) == 0  # peek must not register pools
