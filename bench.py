@@ -487,6 +487,15 @@ def worker() -> None:
         flops = _model_flops_per_step(cfg, accum * batch)
         result["mfu"] = round(flops / step_s / TPU_PEAK_BF16[gen], 4)
         result["tpu_gen"] = gen
+    if not on_tpu:
+        # same-code CPU numbers vary ±35% across sandbox sessions (the
+        # round-1 denominator was measured on a faster day; BASELINE.md
+        # round-4 shows round-2 code at 122 vs HEAD's 146 back-to-back)
+        result["note"] = (
+            "CPU fallback: cross-session CPU throughput varies with "
+            "sandbox load; vs_baseline here is not code-regression "
+            "evidence (see BASELINE.md round-4 investigation)"
+        )
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
         peak = stats.get("peak_bytes_in_use")
