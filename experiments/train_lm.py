@@ -382,6 +382,50 @@ def run_swarm(args):
         times = list(model.moes[0].dispatch_times)
         return float(np.median(times) * 1000) if times else None
 
+    def server_update_total() -> int | None:
+        """Total async optimizer steps applied across all experts — the
+        evidence the server-side SGD is running.  In-process servers are
+        read directly; subprocess/remote servers via CONCURRENT info RPCs
+        on the pooled connections (a sequential per-expert loop would
+        stall the training loop by n_experts × RTT every log interval)."""
+        if servers:
+            return sum(
+                b.update_count
+                for srv in servers
+                for b in srv.experts.values()
+            )
+        try:
+            import asyncio
+
+            from learning_at_home_tpu.client.rpc import (
+                client_loop,
+                pool_registry,
+            )
+
+            alive_all: dict = {}
+            for layer in range(args.n_layers):
+                alive_all.update(
+                    client_dht._loop.run(client_dht._get_alive(f"ffn{layer}"))
+                )
+            registry = pool_registry()
+
+            async def gather_counts():
+                async def one(uid, ep):
+                    _, meta = await registry.get(ep).rpc(
+                        "info", (), {"uid": uid}, timeout=5.0
+                    )
+                    return int(meta.get("update_count", 0))
+
+                results = await asyncio.gather(
+                    *(one(u, e) for u, e in alive_all.items()),
+                    return_exceptions=True,
+                )
+                return sum(r for r in results if isinstance(r, int))
+
+            return client_loop().run(gather_counts())
+        except Exception:
+            return None  # telemetry must never kill the training loop
+
     try:
         if args.pipeline > 1:
             from learning_at_home_tpu.client import PipelinedSwarmTrainer
@@ -445,15 +489,7 @@ def run_swarm(args):
                                 "loss": round(float(loss), 4),
                                 "tokens_per_sec": round(tps, 1),
                                 "dispatch_p50_ms": round(p50, 2) if p50 else None,
-                                "server_updates": (
-                                    sum(
-                                        b.update_count
-                                        for srv in servers
-                                        for b in srv.experts.values()
-                                    )
-                                    if servers
-                                    else None  # remote processes: see info RPC
-                                ),
+                                "server_updates": server_update_total(),
                             }
                         ),
                         flush=True,
