@@ -385,9 +385,9 @@ def run_swarm(args):
     def server_update_total() -> int | None:
         """Total async optimizer steps applied across all experts — the
         evidence the server-side SGD is running.  In-process servers are
-        read directly; subprocess/remote servers via CONCURRENT info RPCs
-        on the pooled connections (a sequential per-expert loop would
-        stall the training loop by n_experts × RTT every log interval)."""
+        read directly; subprocess/remote servers via ONE server-wide
+        ``stats`` RPC per peer, issued concurrently (per-expert queries
+        would cost n_experts × RTT every log interval)."""
         if servers:
             return sum(
                 b.update_count
@@ -407,18 +407,19 @@ def run_swarm(args):
                 alive_all.update(
                     client_dht._loop.run(client_dht._get_alive(f"ffn{layer}"))
                 )
+            endpoints = {tuple(ep) for ep in alive_all.values()}
             registry = pool_registry()
 
             async def gather_counts():
-                async def one(uid, ep):
+                # ONE server-wide stats RPC per peer (not per expert)
+                async def one(ep):
                     _, meta = await registry.get(ep).rpc(
-                        "info", (), {"uid": uid}, timeout=5.0
+                        "stats", (), {}, timeout=5.0
                     )
-                    return int(meta.get("update_count", 0))
+                    return int(meta.get("update_count_total", 0))
 
                 results = await asyncio.gather(
-                    *(one(u, e) for u, e in alive_all.items()),
-                    return_exceptions=True,
+                    *(one(ep) for ep in endpoints), return_exceptions=True
                 )
                 return sum(r for r in results if isinstance(r, int))
 

@@ -211,6 +211,44 @@ class ConnectionHandler:
                 reply_tensors.extend(result)
         return pack_message("result", reply_tensors, {"parts": reply_parts})
 
+    def _server_stats(self) -> dict:
+        """Server-WIDE counters in one round trip (the ``info`` op is
+        per-expert): ops dashboards and swarm telemetry poll this instead
+        of fanning out one RPC per hosted expert."""
+        srv = self.server
+        experts = {}
+        total_updates = 0
+        for uid, backend in srv.experts.items():
+            experts[uid] = backend.update_count
+            total_updates += backend.update_count
+        pools = {}
+        for kind, pool_map in (
+            ("forward", srv.forward_pools), ("backward", srv.backward_pools)
+        ):
+            rows = padded = batches = 0
+            for p in pool_map.values():
+                rows += p.total_rows
+                padded += p.padded_rows
+                batches += p.batches_formed
+            pools[kind] = {
+                "rows": rows, "padded_rows": padded,
+                "batches_formed": batches,
+                "padding_waste": padded / (rows + padded) if rows + padded else 0.0,
+            }
+        stats = {
+            "n_experts": len(srv.experts),
+            "update_count_total": total_updates,
+            "update_count": experts,
+            "pools": pools,
+        }
+        if srv.chaos is not None:
+            stats["chaos"] = {
+                "delays": srv.chaos.injected_delays,
+                "stragglers": srv.chaos.injected_stragglers,
+                "drops": srv.chaos.injected_drops,
+            }
+        return stats
+
     async def _dispatch(self, payload: bytes) -> bytes:
         try:
             msg_type, tensors, meta = unpack_message(payload)
@@ -243,6 +281,8 @@ class ConnectionHandler:
                 if backend is None:
                     raise ValueError(f"unknown expert uid: {uid!r}")
                 return pack_message("result", meta=backend.get_info())
+            elif msg_type == "stats":
+                return pack_message("result", meta=self._server_stats())
             else:
                 return pack_message(
                     "error", meta={"message": f"unknown message type {msg_type!r}"}

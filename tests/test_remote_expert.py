@@ -136,3 +136,36 @@ def test_server_create_classmethod():
         assert e.info()["name"] == "zoo.0"
     finally:
         srv.shutdown()
+
+
+def test_server_stats_op():
+    """The server-wide `stats` op: one round trip returns update counts
+    and pool/padding counters for every hosted expert (docs/PROTOCOL.md)."""
+    import numpy as np
+
+    from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+    from learning_at_home_tpu.server.server import background_server
+
+    with background_server(
+        num_experts=2, hidden_dim=8, expert_prefix="st", seed=0
+    ) as (endpoint, srv):
+        from learning_at_home_tpu.client import RemoteExpert
+
+        e = RemoteExpert("st.0", endpoint)
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        e.forward_blocking([x])
+        e.backward_blocking([x], [x])  # applies one async update
+
+        async def stats():
+            _, meta = await pool_registry().get(endpoint).rpc(
+                "stats", (), {}, timeout=10.0
+            )
+            return meta
+
+        s = client_loop().run(stats())
+    assert s["n_experts"] == 2
+    assert s["update_count_total"] == 1
+    assert s["update_count"]["st.0"] == 1 and s["update_count"]["st.1"] == 0
+    assert s["pools"]["forward"]["batches_formed"] >= 1
+    assert s["pools"]["forward"]["rows"] >= 3
+    assert 0.0 <= s["pools"]["forward"]["padding_waste"] < 1.0
