@@ -22,6 +22,9 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
 def main():
+    from learning_at_home_tpu.utils.subproc import pin_cpu_if_axon
+
+    pin_cpu_if_axon("RPC benchmark client needs host callbacks")
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--num-experts", type=int, default=16)
     p.add_argument("--expert-cls", default="ffn", choices=["ffn", "nop", "transformer", "swiglu"])

@@ -41,12 +41,21 @@ def parse_args():
                    help="max servers simultaneously dead-or-booting; kills "
                         "beyond this wait (an operator preserves capacity)")
     p.add_argument("--base-port", type=int, default=45160)
+    p.add_argument("--wire-dtype", default=None,
+                   choices=["bfloat16", "float16"],
+                   help="compress activation/grad payloads on the wire")
+    p.add_argument("--latency-weight", type=float, default=0.0,
+                   help="debit expert selection by endpoint RTT EMA")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+
+    from learning_at_home_tpu.utils.subproc import pin_cpu_if_axon
+
+    pin_cpu_if_axon("churn client needs host callbacks")
 
     import jax
     import jax.numpy as jnp
@@ -114,6 +123,8 @@ def main():
             forward_timeout=20.0,
             backward_timeout=20.0,
             alive_ttl=args.ttl / 2,
+            wire_dtype=args.wire_dtype,
+            latency_weight=args.latency_weight,
         )
         gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
         opt = optax.adam(1e-2)

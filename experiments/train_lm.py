@@ -201,22 +201,12 @@ def run_pod(args):
 def run_swarm(args):
     import signal
 
-    # The swarm trainer REQUIRES host callbacks (io_callback under
-    # custom_vjp), which the axon TPU plugin does not implement — and when
-    # the axon relay is down, merely initializing that backend hangs
-    # forever (zero CPU, no error).  Pin CPU before the first device op
-    # ONLY when the ambient environment would resolve to axon (explicitly,
-    # or implicitly via the axon sitecustomize's pool marker); CUDA/other
-    # backends support callbacks and keep their auto-selection.  Pod mode
-    # is the TPU path.
-    amb = os.environ.get("JAX_PLATFORMS", "")
-    if amb == "axon" or (not amb and os.environ.get("PALLAS_AXON_POOL_IPS")):
-        import jax as _jax_cfg
+    # The swarm trainer REQUIRES host callbacks; pod mode is the TPU path.
+    # See utils.subproc.pin_cpu_if_axon for the full rationale.
+    from learning_at_home_tpu.utils.subproc import pin_cpu_if_axon
 
-        _jax_cfg.config.update("jax_platforms", "cpu")
-        print("# swarm mode: pinned JAX to cpu (the axon plugin lacks the "
-              "host callbacks this path needs; pass JAX_PLATFORMS=cuda etc. "
-              "to override)", flush=True)
+    pin_cpu_if_axon("swarm mode needs host callbacks; "
+                    "pass JAX_PLATFORMS=cuda etc. to override")
 
     import jax
     import jax.numpy as jnp

@@ -24,3 +24,28 @@ def clean_jax_subprocess_env(
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
         )
     return env
+
+
+def pin_cpu_if_axon(reason: str = "") -> None:
+    """Pin THIS process's JAX to CPU when the ambient platform would
+    resolve to the axon TPU plugin (explicit ``JAX_PLATFORMS=axon`` or the
+    plugin's pool marker with no explicit choice).
+
+    For the swarm/client tier this is a correctness pin, not a
+    preference: host callbacks (``io_callback`` under ``custom_vjp``) are
+    not implemented by the axon plugin, and when its relay is down merely
+    initializing the backend hangs forever at zero CPU (no error, state S
+    — the round-1 and round-4 failure mode).  Call BEFORE the first
+    device op.  Explicit non-axon platforms (cuda, tpu, cpu) are
+    respected untouched.
+    """
+    amb = os.environ.get("JAX_PLATFORMS", "")
+    # JAX_PLATFORMS may be a comma-separated priority list; the hang
+    # happens whenever axon is tried FIRST
+    first = amb.split(",")[0].strip()
+    if first == "axon" or (not amb and os.environ.get("PALLAS_AXON_POOL_IPS")):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        why = reason or "axon plugin lacks the host callbacks this path needs"
+        print(f"# pinned JAX to cpu ({why})", flush=True)
