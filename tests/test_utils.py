@@ -248,3 +248,23 @@ class TestRttEma:
         assert len(reg._pools) == 0  # peek must not register pools
         pool = reg.get(("127.0.0.1", 1))
         assert reg.peek(("127.0.0.1", 1)) is pool
+
+
+def test_unpack_pure_garbage_frames():
+    """Arbitrary byte strings (not derived from any valid frame — the
+    complement of test_unpack_fuzz_never_hangs_or_corrupts' mutation
+    fuzz) must raise a plain Exception promptly; a hang becomes a loud
+    faulthandler abort instead of a silent CI stall."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(60, exit=True)
+    try:
+        rs = np.random.RandomState(0)
+        for _ in range(300):
+            buf = rs.bytes(int(rs.randint(0, 256)))
+            try:
+                unpack_message(buf)
+            except Exception:
+                pass  # controlled failure is the contract
+    finally:
+        faulthandler.cancel_dump_traceback_later()
