@@ -452,6 +452,7 @@ def run_swarm(args):
                 "tokens_per_sec": round(summary["tokens_per_sec"], 1),
                 "final_loss": round(summary["final_loss"], 4),
                 "dispatch_p50_ms": round(p50, 2) if p50 is not None else None,
+                "server_updates": server_update_total(),
             }), flush=True)
         else:
             t0 = time.perf_counter()
