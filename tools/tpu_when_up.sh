@@ -1,0 +1,22 @@
+#!/bin/bash
+# Runs ONCE when the axon tunnel answers: the round-4 TPU measurement suite.
+cd /root/repo
+log=/tmp/tpu_measure.log
+echo "$(date -u +%H:%M:%S) tunnel up — starting measurement suite" >> "$log"
+run() {
+  name=$1; shift
+  echo "=== $name: $* ===" >> "$log"
+  timeout 1200 env "$@" python bench.py > "/tmp/tpu_${name}.json" 2>>"$log"
+  echo "$(date -u +%H:%M:%S) $name done rc=$?: $(tail -c 400 /tmp/tpu_${name}.json)" >> "$log"
+}
+# 1. the graded artifact path (fused recipe + balanced variant + dispatch p50)
+run bench_main
+# 2. accum ladder at the winning batch
+run bench_accum2 BENCH_ACCUM=2 BENCH_BATCH=176
+run bench_accum4 BENCH_ACCUM=4 BENCH_BATCH=176
+# 3. recipe confirmation through the variant harness
+echo "=== profile_step fused/no-stack ===" >> "$log"
+timeout 900 python experiments/profile_step.py --batch 176 --no-stack --optimizer fused \
+  > /tmp/tpu_profile_fused.json 2>>"$log"
+echo "$(date -u +%H:%M:%S) profile done rc=$?: $(cat /tmp/tpu_profile_fused.json 2>/dev/null)" >> "$log"
+echo "$(date -u +%H:%M:%S) suite complete" >> "$log"
