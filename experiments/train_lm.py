@@ -46,6 +46,19 @@ def parse_args():
                         "heavy runs — a trainer must not share an XLA "
                         "runtime with its servers)")
     p.add_argument("--base-port", type=int, default=45200, help="swarm mode")
+    p.add_argument("--initial-peers", default=None,
+                   help="swarm mode: comma-separated host:port DHT peers of "
+                        "an EXISTING swarm to join as a pure trainer (no "
+                        "servers are spawned; the reference's many-trainer "
+                        "deployment shape)")
+    p.add_argument("--data-shard", default=None, metavar="I:N",
+                   help="train on the I-th of N contiguous corpus shards "
+                        "(disjoint data per trainer in multi-trainer runs)")
+    p.add_argument("--n-trainers", type=int, default=1,
+                   help="swarm mode: spawn this many INDEPENDENT trainer "
+                        "processes (own trunk+gates, disjoint data shards) "
+                        "against one shared expert swarm — the reference's "
+                        "concurrent async-DP deployment (SURVEY §2.2 DP)")
     p.add_argument("--pipeline", type=int, default=1,
                    help="swarm mode: concurrent micro-batch steps in flight "
                         "(PipelinedSwarmTrainer; 1 = sequential). Overlaps "
@@ -102,7 +115,11 @@ def parse_args():
                    help="steps between checkpoints (0 = end of run only)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
-    return p.parse_args()
+    args = p.parse_args()
+    if args.n_trainers > 1 and args.mode != "swarm":
+        p.error("--n-trainers requires --mode swarm (pod mode is one "
+                "jitted SPMD trainer; concurrency there is the mesh)")
+    return args
 
 
 def run_pod(args):
@@ -198,6 +215,124 @@ def run_pod(args):
         print(f"# checkpointed final step {args.steps}", flush=True)
 
 
+def _uids_for_server(args, s: int) -> list[str]:
+    """Experts strided across servers: ffn{layer}.{i} for i ≡ s (mod n)."""
+    return [
+        f"ffn{layer}.{i}"
+        for layer in range(args.n_layers)
+        for i in range(args.experts_per_layer)
+        if i % args.n_servers == s
+    ]
+
+
+def _spawn_servers(args, bootstrap_endpoint):
+    """Launch the expert-server subprocesses of a swarm (shared by the
+    single-trainer --subprocess-servers path and the --n-trainers
+    orchestrator)."""
+    import subprocess
+
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_jax_subprocess_env(repo)
+    procs = []
+    for s in range(args.n_servers):
+        uids = _uids_for_server(args, s)
+        if not uids:
+            continue  # more servers than experts: nothing to host
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "learning_at_home_tpu.server",
+                    "--expert-uids", ",".join(uids),
+                    "--hidden-dim", str(args.d_model),
+                    "--port", str(args.base_port + s),
+                    "--initial-peers",
+                    f"{bootstrap_endpoint[0]}:{bootstrap_endpoint[1]}",
+                    "--update-period", "5.0",
+                    "--optimizer", "adam", "--lr", str(args.lr),
+                    "--max-batch-size", "4096",
+                ]
+                + (
+                    ["--chaos-latency", str(args.chaos_latency)]
+                    if args.chaos_latency
+                    else []
+                )
+                + (
+                    ["--chaos-bandwidth", str(args.chaos_bandwidth)]
+                    if args.chaos_bandwidth
+                    else []
+                ),
+                env=env,
+            )
+        )
+    return procs
+
+
+def _wait_for_experts(client_dht, procs, n_layers: int, want: int,
+                      deadline_s: float = 30.0) -> int:
+    """Poll the DHT until ``want`` experts are alive (or the deadline
+    passes), failing fast if a server subprocess dies during startup.
+    Returns the number found."""
+    deadline = time.time() + deadline_s
+    found = 0
+    while time.time() < deadline:
+        for proc in procs:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"server process exited with {proc.returncode} during "
+                    "startup (port in use? see its log)"
+                )
+        found = sum(
+            len(client_dht._loop.run(client_dht._get_alive(f"ffn{l}")))
+            for l in range(n_layers)
+        )
+        if found >= want:
+            break
+        time.sleep(0.25)
+    return found
+
+
+def _rpc_server_stats(client_dht, n_layers: int) -> dict | None:
+    """Merged server-wide ``stats`` over every alive peer: ONE RPC per
+    endpoint (per-expert ``info`` queries would cost n_experts × RTT).
+    Returns ``{"update_count_total": int, "update_count": {uid: int}}``
+    or None — telemetry must never kill a training loop."""
+    try:
+        import asyncio
+
+        from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+
+        alive_all: dict = {}
+        for layer in range(n_layers):
+            alive_all.update(
+                client_dht._loop.run(client_dht._get_alive(f"ffn{layer}"))
+            )
+        endpoints = {tuple(ep) for ep in alive_all.values()}
+        registry = pool_registry()
+
+        async def gather():
+            async def one(ep):
+                _, meta = await registry.get(ep).rpc("stats", (), {},
+                                                     timeout=5.0)
+                return meta
+
+            return await asyncio.gather(
+                *(one(ep) for ep in endpoints), return_exceptions=True
+            )
+
+        merged = {"update_count_total": 0, "update_count": {}}
+        for meta in client_loop().run(gather()):
+            if isinstance(meta, dict):
+                merged["update_count_total"] += int(
+                    meta.get("update_count_total", 0)
+                )
+                merged["update_count"].update(meta.get("update_count", {}))
+        return merged
+    except Exception:
+        return None
+
+
 def run_swarm(args):
     import signal
 
@@ -239,59 +374,29 @@ def run_swarm(args):
     # grid: experts_per_layer experts in one dimension per layer; experts
     # strided across servers
     grid = (args.experts_per_layer,)
-    bootstrap = DHT()
-    servers, dhts, procs = [], [bootstrap], []
-
-    def uids_for_server(s: int) -> list[str]:
-        return [
-            f"ffn{layer}.{i}"
-            for layer in range(args.n_layers)
-            for i in range(args.experts_per_layer)
-            if i % args.n_servers == s
+    if args.initial_peers:
+        # pure-trainer mode: join an existing swarm (the reference's
+        # many-trainer topology — servers are someone else's processes)
+        peers = [
+            (host, int(port))
+            for host, port in
+            (e.rsplit(":", 1) for e in args.initial_peers.split(","))
         ]
-
-    if args.subprocess_servers:
-        import subprocess
-
-        from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
-
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = clean_jax_subprocess_env(repo)
-        for s in range(args.n_servers):
-            uids = uids_for_server(s)
-            if not uids:
-                continue  # more servers than experts: nothing to host
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable, "-m", "learning_at_home_tpu.server",
-                        "--expert-uids", ",".join(uids),
-                        "--hidden-dim", str(args.d_model),
-                        "--port", str(args.base_port + s),
-                        "--initial-peers",
-                        f"{bootstrap.endpoint[0]}:{bootstrap.endpoint[1]}",
-                        "--update-period", "5.0",
-                        "--optimizer", "adam", "--lr", str(args.lr),
-                        "--max-batch-size", "4096",
-                    ]
-                    + (
-                        ["--chaos-latency", str(args.chaos_latency)]
-                        if args.chaos_latency
-                        else []
-                    )
-                    + (
-                        ["--chaos-bandwidth", str(args.chaos_bandwidth)]
-                        if args.chaos_bandwidth
-                        else []
-                    ),
-                    env=env,
-                )
-            )
+        bootstrap = None
+        servers, dhts, procs = [], [], []
+    elif args.subprocess_servers:
+        bootstrap = DHT()
+        peers = [bootstrap.endpoint]
+        servers, dhts = [], [bootstrap]
+        procs = _spawn_servers(args, bootstrap.endpoint)
     else:
+        bootstrap = DHT()
+        peers = [bootstrap.endpoint]
+        servers, dhts, procs = [], [bootstrap], []
         import zlib
 
         for s in range(args.n_servers):
-            uids = uids_for_server(s)
+            uids = _uids_for_server(args, s)
             if not uids:
                 continue
             experts = {}
@@ -310,26 +415,12 @@ def run_swarm(args):
             server = Server(experts, host="127.0.0.1", dht=dht, update_period=5.0)
             server.run_in_background()
             servers.append(server)
-    client_dht = DHT(initial_peers=[bootstrap.endpoint])
+    client_dht = DHT(initial_peers=peers)
     dhts.append(client_dht)
 
     # wait for all experts to appear in the DHT
     want = args.n_layers * args.experts_per_layer
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        for proc in procs:
-            if proc.poll() is not None:
-                raise SystemExit(
-                    f"server process exited with {proc.returncode} during "
-                    "startup (port in use? see its log)"
-                )
-        found = sum(
-            len(client_dht._loop.run(client_dht._get_alive(f"ffn{l}")))
-            for l in range(args.n_layers)
-        )
-        if found >= want:
-            break
-        time.sleep(0.25)
+    found = _wait_for_experts(client_dht, procs, args.n_layers, want)
     print(f"# discovered {found}/{want} experts via DHT", flush=True)
 
     cfg = SwarmTransformerConfig(
@@ -364,6 +455,13 @@ def run_swarm(args):
                 print(f"# resumed trainer from step {start_step}", flush=True)
 
     tokens = load_corpus(args.data, seed=args.seed)
+    if args.data_shard:
+        i, n = (int(x) for x in args.data_shard.split(":"))
+        if not 0 <= i < n:
+            raise SystemExit(f"--data-shard {args.data_shard}: need 0 <= I < N")
+        lo, hi = i * len(tokens) // n, (i + 1) * len(tokens) // n
+        tokens = tokens[lo:hi]
+        print(f"# data shard {i}:{n} -> tokens [{lo}:{hi})", flush=True)
     batches = LMBatcher(tokens, args.batch_size, args.seq_len, seed=args.seed)
     if start_step:
         batches.skip(start_step)  # continue the data order, no replay
@@ -371,6 +469,16 @@ def run_swarm(args):
     def dispatch_p50() -> float | None:
         times = list(model.moes[0].dispatch_times)
         return float(np.median(times) * 1000) if times else None
+
+    def backward_rpcs() -> tuple[int, int]:
+        """Cumulative (sent, acked) backward RPCs across all MoE layers.
+        ``sent`` is the count the servers' summed ``update_count`` is
+        bounded by in multi-trainer runs (a cancelled straggler still
+        executes server-side, so ``acked`` is NOT an upper bound)."""
+        return (
+            sum(m.backward_rpcs_sent for m in model.moes),
+            sum(m.backward_rpcs_ok for m in model.moes),
+        )
 
     def server_update_total() -> int | None:
         """Total async optimizer steps applied across all experts — the
@@ -384,38 +492,8 @@ def run_swarm(args):
                 for srv in servers
                 for b in srv.experts.values()
             )
-        try:
-            import asyncio
-
-            from learning_at_home_tpu.client.rpc import (
-                client_loop,
-                pool_registry,
-            )
-
-            alive_all: dict = {}
-            for layer in range(args.n_layers):
-                alive_all.update(
-                    client_dht._loop.run(client_dht._get_alive(f"ffn{layer}"))
-                )
-            endpoints = {tuple(ep) for ep in alive_all.values()}
-            registry = pool_registry()
-
-            async def gather_counts():
-                # ONE server-wide stats RPC per peer (not per expert)
-                async def one(ep):
-                    _, meta = await registry.get(ep).rpc(
-                        "stats", (), {}, timeout=5.0
-                    )
-                    return int(meta.get("update_count_total", 0))
-
-                results = await asyncio.gather(
-                    *(one(ep) for ep in endpoints), return_exceptions=True
-                )
-                return sum(r for r in results if isinstance(r, int))
-
-            return client_loop().run(gather_counts())
-        except Exception:
-            return None  # telemetry must never kill the training loop
+        stats = _rpc_server_stats(client_dht, args.n_layers)
+        return stats["update_count_total"] if stats else None
 
     try:
         if args.pipeline > 1:
@@ -447,12 +525,15 @@ def run_swarm(args):
             )
             params, opt_state = trainer.params, trainer.opt_state
             p50 = dispatch_p50()
+            sent, acked = backward_rpcs()
             print(json.dumps({
                 "pipeline": args.pipeline,
                 "tokens_per_sec": round(summary["tokens_per_sec"], 1),
                 "final_loss": round(summary["final_loss"], 4),
                 "dispatch_p50_ms": round(p50, 2) if p50 is not None else None,
                 "server_updates": server_update_total(),
+                "backward_rpcs_sent": sent,
+                "backward_rpcs_ok": acked,
             }), flush=True)
         else:
             t0 = time.perf_counter()
@@ -474,6 +555,7 @@ def run_swarm(args):
                         * args.batch_size * args.seq_len / elapsed
                     )
                     p50 = dispatch_p50()
+                    sent, acked = backward_rpcs()
                     print(
                         json.dumps(
                             {
@@ -482,6 +564,8 @@ def run_swarm(args):
                                 "tokens_per_sec": round(tps, 1),
                                 "dispatch_p50_ms": round(p50, 2) if p50 else None,
                                 "server_updates": server_update_total(),
+                                "backward_rpcs_sent": sent,
+                                "backward_rpcs_ok": acked,
                             }
                         ),
                         flush=True,
@@ -505,10 +589,170 @@ def run_swarm(args):
         reset_client_rpc()
 
 
+def run_multi_trainer(args):
+    """The reference's concurrent async-DP deployment (SURVEY §2.2 DP:
+    "many independent trainers" sharing one expert pool): spawn the expert
+    servers ONCE, then ``--n-trainers`` fully independent trainer
+    processes — each with its own trunk+gate parameters, its own optimizer,
+    and a disjoint contiguous shard of the corpus — all pushing forward and
+    backward batches through the same experts, whose server-side optimizer
+    steps interleave both trainers' gradients with no coordination (true
+    write contention).
+
+    Emits one summary JSON with per-trainer loss curves and the
+    client-vs-server ledger: ``server_updates_total`` must not exceed
+    ``backward_rpcs_ok_total`` (a task pool may merge concurrent trainers'
+    rows into one padded batch = one optimizer step), and with both
+    trainers making progress it must exceed what either trainer alone
+    acked."""
+    import signal
+    import subprocess
+    import threading
+
+    from learning_at_home_tpu.utils.subproc import (
+        clean_jax_subprocess_env,
+        pin_cpu_if_axon,
+    )
+
+    pin_cpu_if_axon("multi-trainer orchestrator only polls the DHT")
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.dht import DHT
+
+    if args.initial_peers:
+        raise SystemExit("--n-trainers spawns its own swarm; "
+                         "drop --initial-peers")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = clean_jax_subprocess_env(repo)
+    bootstrap = DHT()
+    procs = _spawn_servers(args, bootstrap.endpoint)
+    client_dht = DHT(initial_peers=[bootstrap.endpoint])
+    trainers: list[subprocess.Popen] = []
+    logs: list[list[dict]] = [[] for _ in range(args.n_trainers)]
+    try:
+        # all experts discoverable BEFORE any trainer starts (children also
+        # wait, but a shared healthy start keeps their clocks comparable)
+        want = args.n_layers * args.experts_per_layer
+        found = _wait_for_experts(client_dht, procs, args.n_layers, want)
+        print(f"# orchestrator: {found}/{want} experts alive", flush=True)
+
+        peers_arg = f"{bootstrap.endpoint[0]}:{bootstrap.endpoint[1]}"
+        base = [
+            sys.executable, os.path.abspath(__file__), "--mode", "swarm",
+            "--initial-peers", peers_arg,
+            "--steps", str(args.steps),
+            "--batch-size", str(args.batch_size),
+            "--seq-len", str(args.seq_len),
+            "--d-model", str(args.d_model),
+            "--n-layers", str(args.n_layers),
+            "--experts-per-layer", str(args.experts_per_layer),
+            "--n-servers", str(args.n_servers),
+            "--k", str(args.k),
+            "--lr", str(args.lr),
+            "--log-every", str(args.log_every),
+            "--pipeline", str(args.pipeline),
+        ]
+        if args.data:
+            base += ["--data", args.data]
+        if args.wire_dtype:
+            base += ["--wire-dtype", args.wire_dtype]
+        if args.latency_weight:
+            base += ["--latency-weight", str(args.latency_weight)]
+        if args.checkpoint_every:
+            base += ["--checkpoint-every", str(args.checkpoint_every)]
+        for t in range(args.n_trainers):
+            cmd = base + [
+                "--seed", str(args.seed + t),
+                "--data-shard", f"{t}:{args.n_trainers}",
+            ]
+            if args.checkpoint_dir:
+                # each trainer owns its trunk/gate state: per-trainer dirs
+                cmd += ["--checkpoint-dir",
+                        os.path.join(args.checkpoint_dir, f"t{t}")]
+                if args.resume:
+                    cmd += ["--resume"]
+            trainers.append(subprocess.Popen(
+                cmd, env=env, text=True,
+                stdout=subprocess.PIPE, stderr=sys.stderr,
+            ))
+
+        def pump(t: int, proc: subprocess.Popen) -> None:
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                print(f"[t{t}] {line}", flush=True)
+                if line.startswith("{"):
+                    try:
+                        logs[t].append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+
+        pumps = [
+            threading.Thread(target=pump, args=(t, p), daemon=True)
+            for t, p in enumerate(trainers)
+        ]
+        for th in pumps:
+            th.start()
+        rcs = [p.wait() for p in trainers]
+        for th in pumps:
+            th.join(timeout=10)
+        if any(rc != 0 for rc in rcs):
+            raise SystemExit(f"trainer exit codes {rcs}")
+
+        stats = _rpc_server_stats(client_dht, args.n_layers)
+        per_trainer = []
+        for t, entries in enumerate(logs):
+            losses = [e["loss"] for e in entries if "loss" in e]
+
+            def last(key: str) -> int:
+                return max(
+                    (e[key] for e in entries if e.get(key) is not None),
+                    default=0,
+                )
+
+            per_trainer.append({
+                "trainer": t,
+                "first_loss": losses[0] if losses else None,
+                "final_loss": losses[-1] if losses else None,
+                "backward_rpcs_sent": last("backward_rpcs_sent"),
+                "backward_rpcs_ok": last("backward_rpcs_ok"),
+            })
+        sent_total = sum(t["backward_rpcs_sent"] for t in per_trainer)
+        ok_total = sum(t["backward_rpcs_ok"] for t in per_trainer)
+        counts = list((stats or {}).get("update_count", {}).values())
+        print(json.dumps({
+            "n_trainers": args.n_trainers,
+            "trainers": per_trainer,
+            "backward_rpcs_sent_total": sent_total,
+            "backward_rpcs_ok_total": ok_total,
+            "server_updates_total":
+                stats["update_count_total"] if stats else None,
+            "experts_updated": sum(1 for c in counts if c > 0),
+            "n_experts": len(counts),
+        }), flush=True)
+    finally:
+        for proc in trainers:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            proc.terminate()
+        for proc in [*trainers, *procs]:
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
+                proc.wait(timeout=10)  # reap; no zombies
+        client_dht.shutdown()
+        bootstrap.shutdown()
+        reset_client_rpc()
+
+
 def main():
     args = parse_args()
     if args.mode == "pod":
         run_pod(args)
+    elif args.n_trainers > 1:
+        run_multi_trainer(args)
     else:
         run_swarm(args)
 

@@ -158,6 +158,16 @@ class RemoteMixtureOfExperts:
         self.samples_total = 0
         self.samples_dropped = 0
         self.backward_samples_dropped = 0
+        # backward-RPC ledger (guarded by _sessions_lock: pipelined
+        # trainers run _host_backward concurrently).  ``sent`` counts
+        # dispatched grad batches, ``ok`` the replies that came back.
+        # The invariant servers' summed ``update_count`` obeys is
+        # updates ≤ sent — NOT ≤ ok: a post-quorum straggler cancelled
+        # client-side still executes (and updates) server-side, and a
+        # task pool may merge concurrent trainers' tasks into one padded
+        # batch = one optimizer step.
+        self.backward_rpcs_sent = 0
+        self.backward_rpcs_ok = 0
 
     # ---- gate parameters ----
 
@@ -385,6 +395,8 @@ class RemoteMixtureOfExperts:
             )
         session, fwd_dropped = entry
         batch = gy.shape[0]
+        with self._sessions_lock:
+            self.backward_rpcs_sent += len(session)
         results = client_loop().run(
             self._quorum_fanout(
                 msg_type="backward",
@@ -399,6 +411,13 @@ class RemoteMixtureOfExperts:
         )
         gx = np.zeros((batch, gy.shape[-1]), gy.dtype)
         ok = np.zeros(batch, np.int64)
+        with self._sessions_lock:
+            # a reply means the expert ran backward AND queued its async
+            # update, whether or not the grad shape below survives
+            # client-side validation
+            self.backward_rpcs_ok += sum(
+                1 for p in results.values() if p[-1] is not None
+            )
         for uid, payload in results.items():
             reply = payload[-1]
             if reply is None:

@@ -2,6 +2,7 @@
 at tiny scale and emit its JSON — guards the scripts against bitrot."""
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -120,3 +121,46 @@ def test_decode_gap_eval_smoke():
     assert out["gating"] == "expert_choice"
     assert out["eval_ce_training_routing"] > 0
     assert "decode_gap_nats" in out
+
+
+@pytest.mark.slow
+def test_train_lm_multi_trainer_async_dp():
+    """Concurrent multi-trainer async DP (SURVEY §2.2 DP: "many independent
+    trainers" against one shared expert pool; round-4 verdict task 3).
+
+    Two trainer PROCESSES — own trunks/gates/optimizers, disjoint corpus
+    shards — train against the same subprocess expert servers + DHT.  The
+    contract under true write contention: both loss curves fall, numerics
+    stay finite, and the client/server ledger closes — the servers' summed
+    ``update_count`` cannot exceed the trainers' total SENT backward RPCs
+    (each update executes ≥1 sent task; pools may merge concurrent
+    trainers' rows into one padded batch = one optimizer step; acked is
+    NOT the bound — a post-quorum straggler cancelled client-side still
+    executes server-side) yet must exceed what either trainer alone sent
+    (both trainers' gradients were applied)."""
+    lines = run_script(
+        [
+            "experiments/train_lm.py", "--mode", "swarm",
+            "--n-trainers", "2", "--steps", "16",
+            "--experts-per-layer", "4", "--n-servers", "2",
+            "--n-layers", "1", "--batch-size", "2", "--d-model", "32",
+            "--seq-len", "16", "--log-every", "1", "--lr", "0.005",
+            "--base-port", "45340",
+        ],
+        timeout=600,
+    )
+    summary = next(l for l in lines if "n_trainers" in l)
+    assert summary["n_trainers"] == 2
+    for t in summary["trainers"]:
+        # measured drop is ~2.3 nats in 16 steps; 0.5 leaves 4x margin for
+        # async-interleaving nondeterminism (no wall-clock dependence)
+        assert t["final_loss"] < t["first_loss"] - 0.5, t
+        assert math.isfinite(t["final_loss"]), t
+        assert t["backward_rpcs_ok"] > 0, t
+        assert t["backward_rpcs_sent"] >= t["backward_rpcs_ok"], t
+    total_sent = summary["backward_rpcs_sent_total"]
+    updates = summary["server_updates_total"]
+    max_single = max(t["backward_rpcs_sent"] for t in summary["trainers"])
+    assert 0 < updates <= total_sent, summary
+    assert updates > max_single, summary  # both trainers' work was applied
+    assert summary["experts_updated"] >= 3, summary  # load spread over grid
