@@ -153,6 +153,8 @@ class RemoteMixtureOfExperts:
         # dispatch latency telemetry (north-star: dispatch p50); bounded so
         # long runs don't grow memory
         self.dispatch_times: deque[float] = deque(maxlen=10_000)
+        # per-dispatch selected-uid sets (bounded like dispatch_times)
+        self.selection_log: deque[frozenset] = deque(maxlen=10_000)
         # per-sample quorum telemetry: samples whose reply count fell below
         # k_min (forward) / backward_k_min (backward) and were masked out
         self.samples_total = 0
@@ -301,6 +303,11 @@ class RemoteMixtureOfExperts:
             logits, alive_uids, self.k_best, bias=bias
         )  # [B, k']
         k_eff = sel.shape[1]
+        # which experts this dispatch actually selected — the observable
+        # the latency-aware-routing tests assert on (mechanism, not clock)
+        self.selection_log.append(
+            frozenset(alive_uids[e] for e in np.unique(sel))
+        )
 
         # group rows by chosen expert: expert -> (rows, slots)
         jobs: dict[int, tuple[np.ndarray, np.ndarray]] = {}

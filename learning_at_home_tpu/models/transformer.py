@@ -115,6 +115,11 @@ class DMoETransformerConfig:
     # token-chunk size for the rematerialized cross-entropy (peak logits
     # memory = ce_chunk × vocab × 4 bytes; see loss_fn)
     ce_chunk: int = 1024
+    # "chunked" (default): checkpointed [ce_chunk, V] scan.  "fused": the
+    # Pallas streaming-LSE kernel (ops/fused_ce.py) — logits never touch
+    # HBM; single-device meshes only, falls back to chunked otherwise.
+    # Opt-in until validated on hardware (tunnel down rounds 3-5).
+    ce_impl: str = "chunked"
 
 
 class DMoETransformerLM:
@@ -473,10 +478,21 @@ class DMoETransformerLM:
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
                 f"seq_len {s}"
             )
+        if max_new_tokens < 0:
+            # almost certainly caller arithmetic gone negative (e.g. a
+            # token budget minus the prompt length) — refuse loudly
+            raise ValueError(
+                f"max_new_tokens must be >= 0, got {max_new_tokens}"
+            )
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if temperature > 0 and rng is None:
             raise ValueError("temperature > 0 requires an rng key")
+        if max_new_tokens == 0:
+            # nothing to decode (validation above still applies); the
+            # cached path would otherwise allocate a (b, 0) output
+            # buffer and fail at trace time on .at[:, 0]
+            return prompt_ids
         if use_cache:
             if self.cfg.seq_parallel:
                 raise NotImplementedError(
@@ -709,6 +725,39 @@ class DMoETransformerLM:
         n = x.shape[0] * x.shape[1]
         flat_x = x.reshape(n, x.shape[-1])
         flat_t = targets.reshape(n)
+
+        from learning_at_home_tpu.ops.fused_ce import (
+            DEFAULT_BLOCK_N,
+            DEFAULT_BLOCK_V,
+            _check,
+            fused_softmax_ce,
+        )
+
+        if (
+            self.cfg.ce_impl == "fused"
+            and self.mesh.devices.size == 1
+            and _check(flat_x, head, flat_t,
+                       DEFAULT_BLOCK_N, DEFAULT_BLOCK_V) is None
+        ):
+            # Pallas streaming-LSE CE: no [chunk, V] HBM round-trips at
+            # all (see ops/fused_ce.py for the roofline argument).  When
+            # the kernel's shape constraints DON'T hold we fall through
+            # to the chunked scan below — NOT to a full [n, V] logits
+            # materialization, which would blow the memory bound the
+            # chunking exists for.  Interpret mode keeps CPU tests exact.
+            interpret = jax.devices()[0].platform == "cpu"
+            ce_rows = fused_softmax_ce(
+                flat_x, head, flat_t,
+                DEFAULT_BLOCK_N, DEFAULT_BLOCK_V, interpret,
+            )
+            ce = ce_rows.sum() / n
+            loss = (
+                ce
+                + self.cfg.aux_loss_weight * aux["aux_loss"]
+                + self.cfg.router_z_weight * aux["router_z_loss"]
+            )
+            return loss, {"ce": ce, **aux}
+
         chunk = min(self.cfg.ce_chunk, n)
 
         def chunk_ce(carry, xt):

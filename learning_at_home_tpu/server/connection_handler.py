@@ -58,14 +58,31 @@ logger = logging.getLogger(__name__)
 
 
 def upcast_from_wire(tensors, wire: str | None) -> list:
-    """Wire-compressed floating tensors → float32 compute dtype."""
+    """Wire-compressed floating tensors → float32 compute dtype.
+
+    A declared wire dtype is a CONTRACT: every floating payload must
+    actually carry it.  Keying the upcast on each tensor's observed dtype
+    would silently launder a client-side encoding bug (e.g. wire=bfloat16
+    declared, float64 sent) into a normal-looking float32 batch; reject
+    the mismatch so the client gets an error reply instead (round-4
+    advisor)."""
     if not wire:
         return list(tensors)
-    return [
-        t.astype(np.float32)
-        if is_float_dtype(np.asarray(t).dtype) else t
-        for t in tensors
-    ]
+    expected = np.dtype(wire)
+    out = []
+    for t in tensors:
+        arr = np.asarray(t)
+        if is_float_dtype(arr.dtype):
+            if arr.dtype != expected:
+                raise ValueError(
+                    f"request declares wire={wire} but carries a "
+                    f"{arr.dtype} floating tensor — client-side encoding "
+                    "bug; refusing to upcast"
+                )
+            out.append(arr.astype(np.float32))
+        else:
+            out.append(t)
+    return out
 
 
 def downcast_to_wire(tensors, wire: str | None) -> list:

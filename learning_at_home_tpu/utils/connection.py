@@ -92,9 +92,17 @@ class ConnectionPool:
                 # the timeout would never be penalized at all.  Fast
                 # failures (refused connection, reset) say nothing about
                 # latency and must NOT reward a broken peer with a small
-                # EMA — skip those.
-                if isinstance(e, (TimeoutError, asyncio.CancelledError)):
-                    self._update_rtt(loop.time() - t0)
+                # EMA — skip those.  Cancels below a small floor are
+                # teardown/shutdown cancellations unrelated to the peer
+                # (a quorum straggler cancel arrives only after the grace
+                # period, well past the floor): folding their near-zero
+                # dt would REWARD a slow peer with an artificially low
+                # EMA and steer latency-aware selection toward it.
+                dt = loop.time() - t0
+                if isinstance(e, TimeoutError) or (
+                    isinstance(e, asyncio.CancelledError) and dt >= 0.05
+                ):
+                    self._update_rtt(dt)
                 raise
             dt = loop.time() - t0
             self._free.put_nowait((reader, writer))

@@ -431,3 +431,115 @@ class TestTokenMask:
         assert (w[t >= 2] == 0.0).all()  # padding tokens weightless
         # both real tokens are covered -> uncovered (over real) == 0
         assert float(plan.uncovered_fraction) == 0.0
+
+
+class TestFusedCE:
+    """ops/fused_ce.py: streaming-LSE CE, interpret mode (SURVEY §4 —
+    kernel equivalence on CPU; on-chip validation gated on the tunnel)."""
+
+    def _setup(self, n=256, d=128, v=2048, dtype=np.float32, seed=0):
+        rs = np.random.RandomState(seed)
+        x = jnp.asarray(rs.randn(n, d).astype(dtype))
+        head = jnp.asarray((rs.randn(d, v) * 0.05).astype(dtype))
+        t = jnp.asarray(rs.randint(0, v, n).astype(np.int32))
+        return x, head, t
+
+    def test_forward_matches_reference(self):
+        import optax
+
+        from learning_at_home_tpu.ops.fused_ce import fused_softmax_ce
+
+        x, head, t = self._setup()
+        ref = optax.softmax_cross_entropy_with_integer_labels(x @ head, t)
+        ce = fused_softmax_ce(x, head, t, 128, 512, True)
+        np.testing.assert_allclose(np.asarray(ce), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_grads_match_reference(self):
+        import optax
+
+        from learning_at_home_tpu.ops.fused_ce import fused_softmax_ce
+
+        x, head, t = self._setup()
+
+        def loss_f(x, h):
+            return fused_softmax_ce(x, h, t, 128, 512, True).mean()
+
+        def loss_r(x, h):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                x @ h, t
+            ).mean()
+
+        gx, gh = jax.grad(loss_f, argnums=(0, 1))(x, head)
+        rx, rh = jax.grad(loss_r, argnums=(0, 1))(x, head)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(rh), atol=1e-6)
+
+    def test_bf16_storage_f32_stats(self):
+        """bf16 operands: reductions/accumulators stay f32, so the fused
+        CE must sit within bf16-rounding distance of the f32-logits
+        reference computed from the SAME bf16 inputs."""
+        import ml_dtypes
+        import optax
+
+        from learning_at_home_tpu.ops.fused_ce import fused_softmax_ce
+
+        x, head, t = self._setup(dtype=ml_dtypes.bfloat16)
+        ref = optax.softmax_cross_entropy_with_integer_labels(
+            jnp.einsum("nd,dv->nv", x, head,
+                       preferred_element_type=jnp.float32), t
+        )
+        ce = fused_softmax_ce(x, head, t, 128, 512, True)
+        np.testing.assert_allclose(np.asarray(ce), np.asarray(ref),
+                                   atol=1e-3, rtol=1e-3)
+
+    def test_auto_falls_back_on_bad_shapes(self):
+        import optax
+
+        from learning_at_home_tpu.ops.fused_ce import fused_softmax_ce_auto
+
+        x, head, t = self._setup(n=100, d=96, v=777)  # violates everything
+        ref = optax.softmax_cross_entropy_with_integer_labels(x @ head, t)
+        ce = fused_softmax_ce_auto(x, head, t, interpret=True)
+        np.testing.assert_allclose(np.asarray(ce), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_loss_fn_fused_matches_chunked(self):
+        """ce_impl='fused' through the REAL model loss: same loss and
+        same trunk gradients as the chunked path."""
+        import dataclasses
+
+        from learning_at_home_tpu.models.transformer import (
+            DMoETransformerConfig,
+            DMoETransformerLM,
+        )
+        from learning_at_home_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"expert": 1}, devices=jax.devices()[:1])
+        cfg = DMoETransformerConfig(
+            vocab_size=2048, d_model=128, n_layers=1, n_heads=4,
+            seq_len=16, num_experts=4, k=2, dtype=jnp.float32,
+            ce_chunk=64,
+        )
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 2048, (8, 16)), jnp.int32)
+        tgt = jnp.asarray(rs.randint(0, 2048, (8, 16)), jnp.int32)
+
+        chunked = DMoETransformerLM(cfg, mesh)
+        params = chunked.init_params(jax.random.PRNGKey(0))
+        fused = DMoETransformerLM(
+            dataclasses.replace(cfg, ce_impl="fused"), mesh
+        )
+
+        lc, _ = chunked.loss_fn(params, ids, tgt)
+        lf, _ = fused.loss_fn(params, ids, tgt)
+        np.testing.assert_allclose(float(lc), float(lf), rtol=1e-5)
+
+        gc = jax.grad(lambda p: chunked.loss_fn(p, ids, tgt)[0])(params)
+        gf = jax.grad(lambda p: fused.loss_fn(p, ids, tgt)[0])(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            ),
+            gc, gf,
+        )

@@ -281,3 +281,27 @@ def test_unpack_pure_garbage_frames():
     finally:
         if own_guard:
             faulthandler.cancel_dump_traceback_later()
+
+
+def test_upcast_from_wire_rejects_dtype_mismatch():
+    """A declared wire dtype is a contract: floating payloads of any OTHER
+    dtype are a client-side encoding bug and must be rejected, not
+    silently laundered into float32 (round-4 advisor)."""
+    import ml_dtypes
+    import pytest
+
+    from learning_at_home_tpu.server.connection_handler import (
+        upcast_from_wire,
+    )
+
+    good = np.ones((2, 2), ml_dtypes.bfloat16)
+    ints = np.arange(4, dtype=np.int32)
+    out = upcast_from_wire([good, ints], "bfloat16")
+    assert out[0].dtype == np.float32
+    assert out[1].dtype == np.int32  # non-float payloads ride along as-is
+
+    with pytest.raises(ValueError, match="declares wire=bfloat16"):
+        upcast_from_wire([np.ones((2, 2), np.float64)], "bfloat16")
+    # no declared wire: anything goes, nothing is cast
+    passthrough = upcast_from_wire([np.ones(2, np.float64)], None)
+    assert passthrough[0].dtype == np.float64

@@ -14,9 +14,22 @@ run bench_main
 # 2. accum ladder at the winning batch
 run bench_accum2 BENCH_ACCUM=2 BENCH_BATCH=176
 run bench_accum4 BENCH_ACCUM=4 BENCH_BATCH=176
+# 2b. fused Pallas CE (round-5 kernel, ops/fused_ce.py): roofline predicts
+#     ~40-50 ms/step of logits HBM traffic removed -> step ~273 -> ~225 ms
+run bench_fusedce BENCH_CE=fused
 # 3. recipe confirmation through the variant harness
 echo "=== profile_step fused/no-stack ===" >> "$log"
 timeout 900 python experiments/profile_step.py --batch 176 --no-stack --optimizer fused \
   > /tmp/tpu_profile_fused.json 2>>"$log"
 echo "$(date -u +%H:%M:%S) profile done rc=$?: $(cat /tmp/tpu_profile_fused.json 2>/dev/null)" >> "$log"
+# 4. decode-gap eval one notch up (round-4 verdict task 7): 64 experts,
+#    real corpus, on-chip.  NOTE: the roofline (tools/roofline.py) predicts
+#    the accum rows above come out NET NEGATIVE vs accum=1 — they are a
+#    falsifiable prediction test now, not an MFU lever.
+echo "=== decode_gap 64-expert on-chip ===" >> "$log"
+timeout 300 python experiments/build_corpus.py --out /tmp/pydoc_corpus.txt >> "$log" 2>&1
+timeout 1800 python experiments/decode_gap_eval.py --data /tmp/pydoc_corpus.txt \
+  --steps 150 --num-experts 64 --d-model 256 \
+  > /tmp/tpu_decode_gap64.json 2>>"$log"
+echo "$(date -u +%H:%M:%S) decode_gap done rc=$?: $(cat /tmp/tpu_decode_gap64.json 2>/dev/null)" >> "$log"
 echo "$(date -u +%H:%M:%S) suite complete" >> "$log"
