@@ -117,9 +117,15 @@ class DMoETransformerConfig:
     ce_chunk: int = 1024
     # "chunked" (default): checkpointed [ce_chunk, V] scan.  "fused": the
     # Pallas streaming-LSE kernel (ops/fused_ce.py) — logits never touch
-    # HBM; single-device meshes only, falls back to chunked otherwise.
-    # Opt-in until validated on hardware (tunnel down rounds 3-5).
+    # HBM; multi-device meshes run it per-shard under shard_map (no seq
+    # parallelism), anything else falls back to chunked.  Opt-in until
+    # validated on hardware (tunnel down rounds 3-5).
     ce_impl: str = "chunked"
+    # fused-CE tile sizes (row tile, vocab tile); vocab tile must divide
+    # V and be a multiple of 128 (lane dim), row tile must divide the
+    # (per-shard) token count
+    ce_block_n: int = 128
+    ce_block_v: int = 1024
 
 
 class DMoETransformerLM:
@@ -709,6 +715,76 @@ class DMoETransformerLM:
 
     # ---- loss / train step ----
 
+    def _fused_ce_or_none(self, x, head, targets, flat_x, flat_t, n):
+        """Mean CE via the Pallas streaming-LSE kernel (ops/fused_ce.py)
+        when ``ce_impl="fused"`` and the kernel's constraints hold —
+        else None, and the caller runs the chunked scan (NOT a full
+        [n, V] logits materialization, which would blow the memory bound
+        the chunking exists for).
+
+        Multi-device meshes without seq parallelism run the kernel
+        per-shard under ``shard_map``: each device computes CE for its
+        own batch rows against a replicated head (the kernel's dhead
+        cotangent is psum-reduced by the shard_map transpose).  Ring-
+        sharded sequences fall back to chunked — the flat token axis
+        would interleave shards."""
+        if self.cfg.ce_impl != "fused":
+            return None
+        from learning_at_home_tpu.ops.fused_ce import (
+            _check,
+            fused_softmax_ce,
+        )
+
+        bn, bv = self.cfg.ce_block_n, self.cfg.ce_block_v
+        interpret = jax.devices()[0].platform == "cpu"
+        if self.mesh.devices.size == 1:
+            if _check(flat_x, head, flat_t, bn, bv) is not None:
+                return None
+            ce_rows = fused_softmax_ce(flat_x, head, flat_t, bn, bv,
+                                       interpret)
+            return ce_rows.sum() / n
+
+        from jax import shard_map
+
+        from learning_at_home_tpu.parallel.mesh import data_axes
+
+        if "seq" in self.mesh.axis_names and self.mesh.shape["seq"] > 1:
+            return None
+        da = data_axes(self.mesh)
+        n_shards = 1
+        for a in da:
+            n_shards *= self.mesh.shape[a]
+        b, s, d = x.shape
+        if b % n_shards:
+            return None
+        n_loc = (b // n_shards) * s
+        # the same predicate the kernel enforces, applied to the LOCAL
+        # per-shard shapes — one source of truth, so a constraint added
+        # to _check keeps meaning "fall back to chunked", never a trace
+        # error inside shard_map
+        if _check(
+            jax.ShapeDtypeStruct((n_loc, d), x.dtype), head,
+            jax.ShapeDtypeStruct((n_loc,), jnp.int32), bn, bv,
+        ) is not None:
+            return None
+
+        def _local_ce(xl, hl, tl):
+            bl, sl, dl = xl.shape
+            ce_l = fused_softmax_ce(
+                xl.reshape(bl * sl, dl), hl, tl.reshape(bl * sl),
+                bn, bv, interpret,
+            )
+            return ce_l.reshape(bl, sl)
+
+        ce_bs = shard_map(
+            _local_ce,
+            mesh=self.mesh,
+            in_specs=(P(da, None, None), P(None, None), P(da, None)),
+            out_specs=P(da, None),
+            check_vma=False,  # custom_vjp inside has no varying-axes rule
+        )(x, head, targets)
+        return ce_bs.sum() / n
+
     def loss_fn(
         self, params: Params, token_ids: jax.Array, targets: jax.Array
     ) -> tuple[jax.Array, dict]:
@@ -726,31 +802,8 @@ class DMoETransformerLM:
         flat_x = x.reshape(n, x.shape[-1])
         flat_t = targets.reshape(n)
 
-        from learning_at_home_tpu.ops.fused_ce import (
-            DEFAULT_BLOCK_N,
-            DEFAULT_BLOCK_V,
-            _check,
-            fused_softmax_ce,
-        )
-
-        if (
-            self.cfg.ce_impl == "fused"
-            and self.mesh.devices.size == 1
-            and _check(flat_x, head, flat_t,
-                       DEFAULT_BLOCK_N, DEFAULT_BLOCK_V) is None
-        ):
-            # Pallas streaming-LSE CE: no [chunk, V] HBM round-trips at
-            # all (see ops/fused_ce.py for the roofline argument).  When
-            # the kernel's shape constraints DON'T hold we fall through
-            # to the chunked scan below — NOT to a full [n, V] logits
-            # materialization, which would blow the memory bound the
-            # chunking exists for.  Interpret mode keeps CPU tests exact.
-            interpret = jax.devices()[0].platform == "cpu"
-            ce_rows = fused_softmax_ce(
-                flat_x, head, flat_t,
-                DEFAULT_BLOCK_N, DEFAULT_BLOCK_V, interpret,
-            )
-            ce = ce_rows.sum() / n
+        ce = self._fused_ce_or_none(x, head, targets, flat_x, flat_t, n)
+        if ce is not None:
             loss = (
                 ce
                 + self.cfg.aux_loss_weight * aux["aux_loss"]

@@ -151,6 +151,9 @@ def _dhead_kernel(x_ref, head_ref, tgt_ref, lse_ref, dce_ref, dh_ref,
 
 
 def _check(x, head, targets, block_n, block_v) -> str | None:
+    """Single source of truth for the kernel's preconditions — callers
+    (including loss_fn's multi-device guard, which passes per-shard
+    ShapeDtypeStructs) must fall back when this returns a reason."""
     n, d = x.shape
     d2, v = head.shape
     if d != d2:
@@ -161,6 +164,10 @@ def _check(x, head, targets, block_n, block_v) -> str | None:
         return f"n={n} % {block_n} or V={v} % {block_v} != 0"
     if d % 128:
         return f"d={d} % 128 != 0 (lane dim)"
+    if block_v % 128:
+        return f"block_v={block_v} % 128 != 0 (lane dim of the logits tile)"
+    if block_n % 8:
+        return f"block_n={block_n} % 8 != 0 (sublane dim)"
     return None
 
 

@@ -543,3 +543,49 @@ class TestFusedCE:
             ),
             gc, gf,
         )
+
+    def test_loss_fn_fused_multi_device_shard_map(self):
+        """ce_impl='fused' on an 8-device mesh: the kernel runs per-shard
+        under shard_map (replicated head, psum'd dhead cotangent) and
+        must match the chunked path's loss and gradients."""
+        import dataclasses
+
+        from learning_at_home_tpu.models.transformer import (
+            DMoETransformerConfig,
+            DMoETransformerLM,
+        )
+        from learning_at_home_tpu.parallel import batch_sharding, make_mesh
+
+        mesh = make_mesh({"data": 2, "expert": 4})
+        cfg = DMoETransformerConfig(
+            vocab_size=2048, d_model=128, n_layers=1, n_heads=4,
+            seq_len=16, num_experts=8, k=2, dtype=jnp.float32,
+            ce_chunk=64,
+        )
+        rs = np.random.RandomState(0)
+        # batch 64: 8 rows per data-shard-group -> 8*16=128 local tokens
+        ids = jax.device_put(
+            jnp.asarray(rs.randint(0, 2048, (64, 16)), jnp.int32),
+            batch_sharding(mesh),
+        )
+        tgt = jax.device_put(
+            jnp.asarray(rs.randint(0, 2048, (64, 16)), jnp.int32),
+            batch_sharding(mesh),
+        )
+        chunked = DMoETransformerLM(cfg, mesh)
+        params = chunked.init_params(jax.random.PRNGKey(0))
+        fused = DMoETransformerLM(
+            dataclasses.replace(cfg, ce_impl="fused"), mesh
+        )
+        lc, _ = jax.jit(chunked.loss_fn)(params, ids, tgt)
+        lf, _ = jax.jit(fused.loss_fn)(params, ids, tgt)
+        np.testing.assert_allclose(float(lc), float(lf), rtol=1e-5)
+
+        gc = jax.jit(jax.grad(lambda p: chunked.loss_fn(p, ids, tgt)[0]))(params)
+        gf = jax.jit(jax.grad(lambda p: fused.loss_fn(p, ids, tgt)[0]))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            ),
+            gc, gf,
+        )
