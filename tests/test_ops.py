@@ -504,7 +504,25 @@ class TestFusedCE:
         np.testing.assert_allclose(np.asarray(ce), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
-    def test_loss_fn_fused_matches_chunked(self):
+    @staticmethod
+    def _counting_kernel(monkeypatch):
+        """Wrap fused_softmax_ce with an invocation counter: parity
+        asserts are VACUOUS if a guard silently falls back to chunked
+        (both sides identical by construction), so engagement must be
+        proven separately."""
+        import learning_at_home_tpu.ops.fused_ce as fce
+
+        hits = []
+        orig = fce.fused_softmax_ce
+
+        def counting(*a, **k):
+            hits.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(fce, "fused_softmax_ce", counting)
+        return hits
+
+    def test_loss_fn_fused_matches_chunked(self, monkeypatch):
         """ce_impl='fused' through the REAL model loss: same loss and
         same trunk gradients as the chunked path."""
         import dataclasses
@@ -531,8 +549,10 @@ class TestFusedCE:
             dataclasses.replace(cfg, ce_impl="fused"), mesh
         )
 
+        hits = self._counting_kernel(monkeypatch)
         lc, _ = chunked.loss_fn(params, ids, tgt)
         lf, _ = fused.loss_fn(params, ids, tgt)
+        assert hits, "fused-CE path fell back to chunked"
         np.testing.assert_allclose(float(lc), float(lf), rtol=1e-5)
 
         gc = jax.grad(lambda p: chunked.loss_fn(p, ids, tgt)[0])(params)
@@ -544,7 +564,7 @@ class TestFusedCE:
             gc, gf,
         )
 
-    def test_loss_fn_fused_multi_device_shard_map(self):
+    def test_loss_fn_fused_multi_device_shard_map(self, monkeypatch):
         """ce_impl='fused' on an 8-device mesh: the kernel runs per-shard
         under shard_map (replicated head, psum'd dhead cotangent) and
         must match the chunked path's loss and gradients."""
@@ -577,8 +597,10 @@ class TestFusedCE:
         fused = DMoETransformerLM(
             dataclasses.replace(cfg, ce_impl="fused"), mesh
         )
+        hits = self._counting_kernel(monkeypatch)
         lc, _ = jax.jit(chunked.loss_fn)(params, ids, tgt)
         lf, _ = jax.jit(fused.loss_fn)(params, ids, tgt)
+        assert hits, "fused-CE shard_map path fell back to chunked"
         np.testing.assert_allclose(float(lc), float(lf), rtol=1e-5)
 
         gc = jax.jit(jax.grad(lambda p: chunked.loss_fn(p, ids, tgt)[0]))(params)
