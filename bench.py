@@ -441,6 +441,11 @@ def worker() -> None:
             cfg,
             param_dtype=jnp.bfloat16,
             remat=True,
+            # "full" is the measured winner at batch 176; "dots" saves
+            # matmul outputs (fewer recompute FLOPs, more activation HBM)
+            # — the roofline's ~18 ms remat-recompute share makes it a
+            # candidate lever for the next chip session (BENCH_REMAT_POLICY)
+            remat_policy=os.environ.get("BENCH_REMAT_POLICY", "full"),
             scan_layers=scan,
             stack_layers=stack,
         )
@@ -710,33 +715,33 @@ def dispatch_worker() -> None:
     from learning_at_home_tpu.server.server import background_server
 
     def measure(moe, rows: int, hid: int, n_dispatch: int, warmup: int,
-                seed: int = 0, jit: bool = False) -> np.ndarray:
+                seed: int = 0, forward_only: bool = False) -> np.ndarray:
+        """EAGER on purpose, both regimes.  ``dispatch_times`` records the
+        FORWARD fan-out latency (t0 → replies accumulated) — the same
+        quantity the swarm trainer's production p50 tracks — so the
+        measurement needs no jit.  Jitting the client here looked
+        faithful but re-introduced the round-2 deadlock class: inside a
+        compiled program on the 1-core XLA:CPU pool, the io_callback's
+        ``np.asarray(arg)`` can wait on producer thunks queued behind the
+        callback itself (intermittent ~50% of runs; the
+        ensure_sync_cpu_dispatch flag protects EAGER callbacks only).
+        The 2048-row regime is forward-only — an eager op-by-op BACKWARD
+        at that scale costs minutes under forced-sync dispatch, and
+        contributes nothing to the forward-dispatch metric anyway."""
         gate = moe.init_gate_params(jax.random.PRNGKey(0))
         rs = np.random.RandomState(seed)
 
         def loss(gate, x):
             return jnp.sum(moe(x, gate) ** 2)
 
-        # Large regime jits as the trainer does: eager grad at 2048 rows
-        # runs the whole backward op-by-op under the forced-synchronous
-        # CPU dispatch — minutes instead of ~300 ms per call.  The small
-        # regime must stay EAGER: its server shares this process, and a
-        # jitted client computation holds the XLA:CPU execution slot
-        # across both callbacks, starving the co-hosted server's jitted
-        # expert fns until the backward times out.
         grad = jax.grad(loss)
-        if jit:
-            grad = jax.jit(grad)
         for _ in range(n_dispatch):
             x = jnp.asarray(rs.randn(rows, hid).astype(np.float32))
-            # block per call: a JITTED call returns futures even with
-            # eager async dispatch disabled, so an unblocked loop QUEUES
-            # all n executions and reads the telemetry deque before most
-            # have run (empty/short percentile input, and the queued
-            # 90 s RPC waits drain into teardown).  Host CPU, no axon
-            # tunnel in this path — block_until_ready is trustworthy.
-            jax.block_until_ready(grad(gate, x))
-        # steady state: the first few calls include jit/trace warmup
+            if forward_only:
+                jax.block_until_ready(moe(x, gate))
+            else:
+                grad(gate, x)  # forward + backward dispatch per call
+        # steady state: the first few calls include warmup
         return np.asarray(moe.dispatch_times)[warmup:]
 
     def p(times: np.ndarray, q: float) -> float:
@@ -835,8 +840,9 @@ def dispatch_worker() -> None:
                 backward_timeout=90.0, timeout_after_k_min=30.0,
             )
             times = measure(moe, rows_l, hid_l, n_dispatch=10, warmup=3,
-                            seed=2, jit=True)
+                            seed=2, forward_only=True)
             out[field] = p(times, 50)
+            out[field.replace("_p50_ms", "_n")] = int(times.size)
         out["dispatch_rows_large"] = rows_l
     finally:
         proc.terminate()

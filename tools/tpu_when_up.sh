@@ -17,6 +17,9 @@ run bench_accum4 BENCH_ACCUM=4 BENCH_BATCH=176
 # 2b. fused Pallas CE (round-5 kernel, ops/fused_ce.py): roofline predicts
 #     ~40-50 ms/step of logits HBM traffic removed -> step ~273 -> ~225 ms
 run bench_fusedce BENCH_CE=fused
+# 2c. remat-policy lever: "dots" trades the ~18 ms remat-recompute share
+#     for activation HBM (may force a smaller batch; the JSON shows both)
+run bench_rematdots BENCH_REMAT_POLICY=dots
 # 3. recipe confirmation through the variant harness
 echo "=== profile_step fused/no-stack ===" >> "$log"
 timeout 900 python experiments/profile_step.py --batch 176 --no-stack --optimizer fused \
