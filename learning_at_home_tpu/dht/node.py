@@ -46,6 +46,9 @@ class DHTNode:
             self.node_id, self.routing_table, self.storage, rpc_timeout
         )
         self._maintenance_task: Optional[asyncio.Task] = None
+        # first-timeout strikes for lookup peers (two-strike eviction);
+        # entries clear on any success or on the eviction itself
+        self._lookup_strikes: dict[DHTID, int] = {}
 
     @classmethod
     async def create(
@@ -89,15 +92,29 @@ class DHTNode:
         # 0.973; with join refreshes it is 1.0 again).  The refreshes also
         # ADVERTISE this node into distant regions, since every contacted
         # peer learns its caller.
-        await asyncio.gather(
-            *(
-                self.find_nearest_nodes(random_id_in_range(b.lower, b.upper))
-                for b in list(self.routing_table.buckets)
-                # the own-ID bucket is exactly what the self-lookup above
-                # just populated — refreshing it again is a wasted round
-                if not (b.lower <= int(self.node_id) < b.upper)
+        # Two passes over a RE-SNAPSHOTTED bucket list, own bucket
+        # included: when the self-lookup taught ≤ k peers the table has
+        # not split yet, so the only bucket IS the own bucket — skipping
+        # it (an earlier "optimization") silently skipped the entire
+        # refresh phase on such joins, and the first refresh round can
+        # split buckets whose new ranges also deserve a lookup.
+        refreshed: set[tuple] = set()
+        for _ in range(2):
+            todo = [
+                b for b in list(self.routing_table.buckets)
+                if (b.lower, b.upper) not in refreshed
+            ]
+            if not todo:
+                break
+            refreshed.update((b.lower, b.upper) for b in todo)
+            await asyncio.gather(
+                *(
+                    self.find_nearest_nodes(
+                        random_id_in_range(b.lower, b.upper)
+                    )
+                    for b in todo
+                )
             )
-        )
 
     async def shutdown(self) -> None:
         if self._maintenance_task is not None:
@@ -189,9 +206,26 @@ class DHTNode:
             replies = await asyncio.gather(*calls)
             for nid, reply in zip(candidates, replies):
                 if reply is None:
-                    self.routing_table.remove_node(nid)
+                    # two-strike eviction, same invariant as maintenance:
+                    # a single timed-out RPC (GC pause, 1-core stall) must
+                    # not evict a live peer — under load that re-thins
+                    # exactly the tables responder-learning densifies
+                    if self._lookup_strikes.get(nid, 0) >= 1:
+                        self._lookup_strikes.pop(nid, None)
+                        self.routing_table.remove_node(nid)
+                    else:
+                        self._lookup_strikes[nid] = 1
                     continue
+                self._lookup_strikes.pop(nid, None)
                 responded[nid] = shortlist[nid]
+                # textbook Kademlia: every node we HEAR FROM refreshes our
+                # table.  Without this, a node only ever learns from
+                # inbound requests (protocol.py add-caller), so a joiner's
+                # own lookups teach it nothing — measured: a late joiner's
+                # table held exactly 1 peer (the bootstrap node) at 32
+                # nodes, the root cause of the thin tables behind the
+                # 128-node hit-rate regression
+                self.routing_table.add_or_update_node(nid, shortlist[nid])
                 if find_value:
                     value_records, peers = reply
                     merge_records(value_records)
