@@ -125,6 +125,7 @@ def main():
                 4,
             ),
             "device_time_s": round(srv.runtime.device_time, 2),
+            "runtime": srv.runtime.stats(),
             "transport": args.transport,
             "chaos": vars(chaos) if chaos else None,
         }

@@ -86,13 +86,17 @@ def main():
         runtime = Runtime()
         runtime.attach_loop(asyncio.get_running_loop())
         runtime.start()
+        # shared serial_key: both pools touch ONE backend's params
+        # (backward donates them), so the double-buffered Runtime must
+        # never overlap their jobs — same invariant Server applies per uid
         fwd_pool = TaskPool(
             backend.forward, "mnist.fwd", batch_timeout=0.001,
-            max_batch_size=backend.max_batch_size,
+            max_batch_size=backend.max_batch_size, serial_key=backend.name,
         )
         bwd_pool = TaskPool(
             lambda t: backend.backward(t[:1], t[1:]), "mnist.bwd",
             batch_timeout=0.001, max_batch_size=backend.max_batch_size,
+            serial_key=backend.name,
         )
         fwd_pool.start(runtime)
         bwd_pool.start(runtime)

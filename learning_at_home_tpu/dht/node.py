@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import itertools
 import logging
 import time
 from typing import Any, Iterable, Optional, Sequence
@@ -46,9 +47,17 @@ class DHTNode:
             self.node_id, self.routing_table, self.storage, rpc_timeout
         )
         self._maintenance_task: Optional[asyncio.Task] = None
-        # first-timeout strikes for lookup peers (two-strike eviction);
-        # entries clear on any success or on the eviction itself
-        self._lookup_strikes: dict[DHTID, int] = {}
+        # First-timeout strikes for lookup peers (two-strike eviction).
+        # Each entry is ``(lookup_id, strike_time)``: eviction requires a
+        # second timeout from a DIFFERENT lookup whose RPC was issued
+        # AFTER the strike was recorded — two in-flight RPCs failing on
+        # one GC pause are one logical event, not two strikes.  Entries
+        # clear on any success, on eviction, and whenever the node leaves
+        # the routing table by any path (no leak for peers that time out
+        # once and are never re-queried).
+        self._lookup_strikes: dict[DHTID, tuple[int, float]] = {}
+        self._lookup_counter = itertools.count()
+        self.routing_table.on_remove = self._on_table_remove
 
     @classmethod
     async def create(
@@ -166,9 +175,48 @@ class DHTNode:
 
     # ---------------- iterative lookup core ----------------
 
+    def _on_table_remove(self, node_id: DHTID) -> None:
+        """RoutingTable removal hook: a departed node's strike entry must
+        not outlive its table membership."""
+        self._lookup_strikes.pop(node_id, None)
+
+    def _record_lookup_timeout(
+        self, nid: DHTID, lookup_id: int, wave_started: float
+    ) -> None:
+        """Two-strike eviction with single-event protection: evict only
+        when a PRIOR strike exists from a different lookup AND was
+        recorded before this wave's RPCs went out (so the peer had a
+        fresh chance between the two failures — concurrent lookups
+        sharing one GC pause cannot double-strike)."""
+        entry = self._lookup_strikes.get(nid)
+        if (
+            entry is not None
+            and entry[0] != lookup_id
+            and entry[1] < wave_started
+        ):
+            # eviction clears the strike via the on_remove hook
+            self.routing_table.remove_node(nid)
+            self._lookup_strikes.pop(nid, None)  # nid may not be in table
+        elif entry is None:
+            self._lookup_strikes[nid] = (lookup_id, time.monotonic())
+            # strikes can reference peers never admitted to the table
+            # (shortlist members learned mid-lookup) — the table hook
+            # can't clear those, so bound the dict under churn.  Entries
+            # are insert-only, so dict order IS strike-time order: drop
+            # the oldest half without sorting (this runs on the loop)
+            if len(self._lookup_strikes) > 65536:
+                for k in list(
+                    itertools.islice(
+                        iter(self._lookup_strikes),
+                        len(self._lookup_strikes) // 2,
+                    )
+                ):
+                    del self._lookup_strikes[k]
+
     async def _iterative_lookup(
         self, target: DHTID, find_value: bool
     ) -> tuple[dict[str, tuple[Any, DHTExpiration]], list[tuple[DHTID, Endpoint]]]:
+        lookup_id = next(self._lookup_counter)
         key_bytes = target.to_bytes()
         # seed with 2k neighbors, not k: a k-sized seed drawn from a
         # sparse table can lie entirely inside one local cluster, and the
@@ -197,6 +245,7 @@ class DHTNode:
             if not candidates:
                 break
             queried.update(candidates)
+            wave_started = time.monotonic()
             calls = [
                 self.protocol.call_find_value(shortlist[nid], key_bytes)
                 if find_value
@@ -210,11 +259,7 @@ class DHTNode:
                     # a single timed-out RPC (GC pause, 1-core stall) must
                     # not evict a live peer — under load that re-thins
                     # exactly the tables responder-learning densifies
-                    if self._lookup_strikes.get(nid, 0) >= 1:
-                        self._lookup_strikes.pop(nid, None)
-                        self.routing_table.remove_node(nid)
-                    else:
-                        self._lookup_strikes[nid] = 1
+                    self._record_lookup_timeout(nid, lookup_id, wave_started)
                     continue
                 self._lookup_strikes.pop(nid, None)
                 responded[nid] = shortlist[nid]

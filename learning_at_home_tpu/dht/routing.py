@@ -12,7 +12,7 @@ from __future__ import annotations
 import hashlib
 import os
 import time
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 Endpoint = tuple[str, int]
 
@@ -111,6 +111,10 @@ class RoutingTable:
         self.node_id = node_id
         self.bucket_size = bucket_size
         self.buckets = [KBucket(0, 2**ID_BITS, bucket_size)]
+        # invoked with the node_id whenever a node is removed from the
+        # table by ANY path — lets the owner drop per-node bookkeeping
+        # (e.g. DHTNode's lookup strikes) that would otherwise leak
+        self.on_remove: Optional[Callable[[DHTID], None]] = None
 
     def _bucket_index(self, node_id: int) -> int:
         for i, b in enumerate(self.buckets):
@@ -132,6 +136,8 @@ class RoutingTable:
 
     def remove_node(self, node_id: DHTID) -> None:
         self.buckets[self._bucket_index(node_id)].remove(node_id)
+        if self.on_remove is not None:
+            self.on_remove(node_id)
 
     def get_endpoint(self, node_id: DHTID) -> Optional[Endpoint]:
         return self.buckets[self._bucket_index(node_id)].peers.get(node_id)

@@ -744,9 +744,8 @@ class DMoETransformerLM:
                                        interpret)
             return ce_rows.sum() / n
 
-        from jax import shard_map
-
         from learning_at_home_tpu.parallel.mesh import data_axes
+        from learning_at_home_tpu.utils.jax_compat import shard_map
 
         if "seq" in self.mesh.axis_names and self.mesh.shape["seq"] > 1:
             return None

@@ -1,6 +1,7 @@
 from learning_at_home_tpu.server.expert_backend import ExpertBackend
 from learning_at_home_tpu.server.task_pool import TaskPool, BatchJob, bucket_rows
 from learning_at_home_tpu.server.runtime import Runtime
+from learning_at_home_tpu.server.staging import StagingBuffers
 from learning_at_home_tpu.server.chaos import ChaosConfig, ChaosInjector
 from learning_at_home_tpu.server.server import Server, background_server
 
@@ -10,6 +11,7 @@ __all__ = [
     "BatchJob",
     "bucket_rows",
     "Runtime",
+    "StagingBuffers",
     "ChaosConfig",
     "ChaosInjector",
     "Server",

@@ -242,21 +242,39 @@ class ConnectionHandler:
         for kind, pool_map in (
             ("forward", srv.forward_pools), ("backward", srv.backward_pools)
         ):
-            rows = padded = batches = 0
+            rows = padded = batches = cold = hits = 0
+            stack_ms = 0.0
+            buckets: dict[int, int] = {}
             for p in pool_map.values():
                 rows += p.total_rows
                 padded += p.padded_rows
                 batches += p.batches_formed
+                stack_ms += p.stack_time * 1e3
+                bs = p.bucket_stats()
+                cold += bs["cold_compiles"]
+                hits += bs["cache_hits"]
+                for bucket, n in bs["batches_per_bucket"].items():
+                    buckets[bucket] = buckets.get(bucket, 0) + n
             pools[kind] = {
                 "rows": rows, "padded_rows": padded,
                 "batches_formed": batches,
                 "padding_waste": padded / (rows + padded) if rows + padded else 0.0,
+                "stack_time_ms": round(stack_ms, 2),
+                # string keys: the msgpack wire rejects int map keys
+                "batches_per_bucket": {
+                    str(b): n for b, n in sorted(buckets.items())
+                },
+                "bucket_cold_compiles": cold,
+                "bucket_cache_hits": hits,
             }
         stats = {
             "n_experts": len(srv.experts),
             "update_count_total": total_updates,
             "update_count": experts,
             "pools": pools,
+            # hot-path pipeline counters: queue depth, stacking/materialize
+            # time, overlap fraction, staging-buffer reuse (ISSUE 1)
+            "runtime": srv.runtime.stats(),
         }
         if srv.chaos is not None:
             stats["chaos"] = {

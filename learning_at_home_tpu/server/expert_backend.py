@@ -90,6 +90,10 @@ class ExpertBackend:
         # RPC so clients can build io_callback result specs without a
         # hand-written ``output_spec_fn``
         self.output_schema: Optional[list] = None
+        # batch buckets AOT-compiled by warmup(): the TaskPool's
+        # compile/hit telemetry counts a first-seen bucket outside this
+        # set as a cold in-request compile
+        self.warm_buckets: frozenset[int] = frozenset()
         self.params = jax.device_put(params)
         self.opt_state = (
             jax.device_put(opt_state)
@@ -220,6 +224,7 @@ class ExpertBackend:
                 self.params, self.opt_state, padded, grad_out
             ).compile()
             compiled += 2
+        self.warm_buckets = self.warm_buckets | frozenset(buckets)
         return compiled
 
     def get_info(self) -> dict:

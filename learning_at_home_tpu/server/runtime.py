@@ -1,4 +1,4 @@
-"""Runtime: the single device-consumer loop executing formed batches.
+"""Runtime: the double-buffered device-consumer loop executing formed batches.
 
 Contract from the reference's ``hivemind/server/runtime.py`` (SURVEY.md §2
 [BJ]; unverifiable refs, mount empty): repeatedly pick the
@@ -12,6 +12,15 @@ thread-safe priority queue of :class:`BatchJob`s.  The jitted XLA call
 releases the GIL, so the asyncio networking loop keeps serving while the
 device computes.  Results are handed back to the event loop via
 ``call_soon_threadsafe``.
+
+The loop is **double-buffered** to exploit XLA's async dispatch: while job
+N's outputs materialize (``np.asarray`` blocks until the device finishes),
+job N+1 has already been stacked — into reusable staging buffers from
+:mod:`.staging` — and its jitted call dispatched, so host work (stacking,
+output copies, future delivery) overlaps device execution instead of
+serializing with it.  The one hard exception: two jobs sharing a pool
+``serial_key`` (forward/backward of the SAME expert — backward donates the
+param buffers forward reads) are never in flight together.
 """
 
 from __future__ import annotations
@@ -21,10 +30,12 @@ import logging
 import queue
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
 
+from learning_at_home_tpu.server.staging import StagingBuffers
 from learning_at_home_tpu.server.task_pool import BatchJob
 from learning_at_home_tpu.utils.profiling import timeline
 
@@ -35,18 +46,34 @@ logger = logging.getLogger(__name__)
 _SENTINEL = (float("-inf"), -1, None)
 
 
+@dataclass
+class _Inflight:
+    """A dispatched-but-not-materialized job (the second pipeline stage)."""
+
+    job: BatchJob
+    raw_outputs: list
+    staging: list = field(default_factory=list)
+    started: float = 0.0
+    dispatch_s: float = 0.0  # duration of the process_fn call itself
+
+
 class Runtime:
-    """Single-threaded device executor fed by all TaskPools of a Server."""
+    """Double-buffered device executor fed by all TaskPools of a Server."""
 
     def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None):
         self._queue: queue.PriorityQueue = queue.PriorityQueue()
         self._loop = loop
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # telemetry
+        self.staging = StagingBuffers()
+        # telemetry (written by the runtime thread; read anywhere)
         self.jobs_processed = 0
-        self.device_time = 0.0
+        self.jobs_overlapped = 0  # dispatched while another job was in flight
+        self.device_time = 0.0  # process_fn + materialization (busy time)
         self.queue_time = 0.0
+        self.stack_time = 0.0
+        self.materialize_time = 0.0
+        self.queue_depth_max = 0
 
     def attach_loop(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
@@ -61,33 +88,136 @@ class Runtime:
     def submit(self, job: BatchJob) -> None:
         """Called from the event loop when a pool has formed a batch."""
         self._queue.put((job.priority, job.seq, job))
+        depth = self._queue.qsize()
+        if depth > self.queue_depth_max:
+            self.queue_depth_max = depth
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            item = self._queue.get()
+        pending: Optional[_Inflight] = None
+        while True:
+            if pending is None:
+                item = self._queue.get()
+            else:
+                try:
+                    # don't wait: if no new job is ready, spend the idle
+                    # time materializing the in-flight one instead
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    self._finish(pending)
+                    pending = None
+                    continue
             _, _, job = item
             if job is None or self._stop.is_set():
+                if pending is not None:
+                    self._finish(pending)
+                    pending = None
                 if job is not None:
                     self._deliver(job, None, RuntimeError("runtime shut down"))
                 break
-            started = time.monotonic()
-            self.queue_time += started - job.formed_at
-            outputs, error = None, None
-            try:
-                with timeline.span(f"runtime.{job.pool.name}"):
-                    outputs = job.pool.process_fn(job.inputs)
-                # Materialize HERE, on the device thread: jit dispatch returns
-                # async arrays, and slicing them later on the event loop would
-                # block all networking until the device finishes.  This also
-                # makes device_time measure actual execution, not dispatch.
-                outputs = [np.asarray(o) for o in outputs]
-            except BaseException as e:  # deliver, don't kill the device loop
-                logger.exception("runtime job failed in pool %s", job.pool.name)
-                error = e
-            self.device_time += time.monotonic() - started
-            self.jobs_processed += 1
-            self._deliver(job, outputs, error)
+            if (
+                pending is not None
+                and pending.job.pool.serial_key == job.pool.serial_key
+            ):
+                # per-expert serialization: never overlap two jobs of the
+                # same expert/pool — drain the pipeline first
+                self._finish(pending)
+                pending = None
+            overlapped = pending is not None
+            inflight = self._dispatch_job(job)
+            if pending is not None:
+                self._finish(pending)
+                pending = None
+            if inflight is not None and overlapped:
+                self.jobs_overlapped += 1
+                timeline.count("runtime.jobs_overlapped")
+            pending = inflight
+        if pending is not None:
+            self._finish(pending)
         self._drain_remaining()
+
+    def _dispatch_job(self, job: BatchJob) -> Optional[_Inflight]:
+        """Stage one: stack the batch into staging buffers and dispatch the
+        jitted call.  Returns the in-flight record, or None if the job
+        failed (error already delivered)."""
+        started = time.monotonic()
+        self.queue_time += started - job.formed_at
+        buffers: list = []
+        try:
+            with timeline.span(f"runtime.stack.{job.pool.name}"):
+                inputs, buffers = job.stack(self.staging)
+            stacked = time.monotonic()
+            self.stack_time += stacked - started
+            job.pool.stack_time += stacked - started
+            with timeline.span(f"runtime.dispatch.{job.pool.name}"):
+                raw = list(job.pool.process_fn(inputs))
+            dispatched = time.monotonic()
+        except BaseException as e:  # deliver, don't kill the device loop
+            logger.exception("runtime job failed in pool %s", job.pool.name)
+            self.staging.release(buffers)
+            self.jobs_processed += 1
+            self._deliver(job, None, e)
+            return None
+        return _Inflight(job, raw, buffers, started, dispatched - stacked)
+
+    def _finish(self, inflight: _Inflight) -> None:
+        """Stage two: materialize the outputs (blocks until the device
+        finishes — this is the wait the NEXT job's dispatch overlaps),
+        recycle the staging buffers, deliver to the pool's futures."""
+        job = inflight.job
+        outputs, error = None, None
+        t0 = time.monotonic()
+        try:
+            with timeline.span(f"runtime.materialize.{job.pool.name}"):
+                outputs = []
+                for o in inflight.raw_outputs:
+                    arr = np.asarray(o)
+                    # a pure-host process_fn can return views INTO the
+                    # staging buffers; those must be copied out before the
+                    # buffer is recycled under the delivered results
+                    if inflight.staging and any(
+                        np.may_share_memory(arr, buf)
+                        for buf in inflight.staging
+                    ):
+                        arr = np.array(arr)
+                    outputs.append(arr)
+        except BaseException as e:
+            logger.exception(
+                "runtime job failed to materialize in pool %s", job.pool.name
+            )
+            error = e
+        now = time.monotonic()
+        self.materialize_time += now - t0
+        # device_time keeps its pre-pipeline meaning — process_fn call +
+        # output materialization, the job's own busy time.  Under overlap,
+        # wall time from dispatch to materialized also contains the NEXT
+        # job's stack/dispatch; folding that in would double-count and
+        # make the pipelined runtime read as a device-time regression.
+        busy = inflight.dispatch_s + (now - t0)
+        self.device_time += busy
+        self.jobs_processed += 1
+        timeline.record(f"runtime.{job.pool.name}", inflight.started, busy)
+        self.staging.release(inflight.staging)
+        self._deliver(job, outputs, error)
+
+    def stats(self) -> dict:
+        """Hot-path telemetry snapshot for the server ``stats`` surface."""
+        jobs = self.jobs_processed
+        return {
+            "jobs_processed": jobs,
+            "jobs_overlapped": self.jobs_overlapped,
+            "overlap_fraction": round(self.jobs_overlapped / jobs, 4) if jobs else 0.0,
+            "device_time_ms": round(self.device_time * 1e3, 2),
+            "queue_time_ms": round(self.queue_time * 1e3, 2),
+            "stack_time_ms": round(self.stack_time * 1e3, 2),
+            "materialize_time_ms": round(self.materialize_time * 1e3, 2),
+            "queue_depth": self.queue_depth,
+            "queue_depth_max": self.queue_depth_max,
+            "staging": self.staging.stats(),
+        }
 
     def _deliver(self, job: BatchJob, outputs, error) -> None:
         try:

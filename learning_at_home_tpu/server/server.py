@@ -71,11 +71,20 @@ class Server:
         self.forward_pools: dict[str, TaskPool] = {}
         self.backward_pools: dict[str, TaskPool] = {}
         for uid, backend in self.experts.items():
+            # forward and backward pools share serial_key=uid: the Runtime's
+            # double buffering may overlap DIFFERENT experts' jobs, but a
+            # backward donates this expert's param buffers while a forward
+            # reads them — same-expert jobs must never be in flight together
+            # a callable so warmup run AFTER Server construction still
+            # registers in the pools' cold-compile telemetry
+            warm = lambda b=backend: getattr(b, "warm_buckets", ())
             self.forward_pools[uid] = TaskPool(
                 backend.forward,
                 f"{uid}.forward",
                 max_batch_size=backend.max_batch_size,
                 batch_timeout=batch_timeout,
+                serial_key=uid,
+                warm_buckets=warm,
             )
             self.backward_pools[uid] = TaskPool(
                 lambda tensors, b=backend: b.backward(
@@ -84,6 +93,8 @@ class Server:
                 f"{uid}.backward",
                 max_batch_size=backend.max_batch_size,
                 batch_timeout=batch_timeout,
+                serial_key=uid,
+                warm_buckets=warm,
             )
         self._loop: Optional[BackgroundLoop] = None
         self._tcp_server: Optional[asyncio.base_events.Server] = None

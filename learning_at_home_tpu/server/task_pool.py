@@ -16,6 +16,12 @@ TPU-native deltas:
   amortized forever; padding waste is tracked in :attr:`padded_rows` /
   :attr:`total_rows` and surfaces in the benchmark metrics (SURVEY.md §7
   "hard parts").
+- **Off-loop stacking**: the pool manager only FORMS batches (picks tasks,
+  computes row spans and the padded bucket — pure metadata).  The actual
+  ``np.concatenate``-equivalent — copying task rows into a padded staging
+  buffer — happens on the Runtime's device thread via :meth:`BatchJob.stack`,
+  so the event loop never blocks on per-batch host memory traffic and the
+  copy overlaps the previous batch's device execution.
 """
 
 from __future__ import annotations
@@ -43,15 +49,53 @@ def bucket_rows(n: int, max_batch_size: int) -> int:
 
 @dataclass(order=True)
 class BatchJob:
-    """One formed batch, queued for the Runtime's device thread."""
+    """One formed batch, queued for the Runtime's device thread.
+
+    Carries the RAW per-task tensors; stacking/padding into one batch
+    array happens in :meth:`stack` on the Runtime thread, never on the
+    event loop.
+    """
 
     priority: float  # oldest task's arrival time → earliest runs first
     seq: int
     pool: "TaskPool" = field(compare=False)
-    inputs: tuple = field(compare=False)  # padded, stacked host arrays
+    task_tensors: list = field(compare=False)  # one tuple of arrays per task
     row_spans: list = field(compare=False)  # (task_future, start, stop)
     n_rows: int = field(compare=False)  # real rows before padding
+    target_rows: int = field(compare=False, default=0)  # padded bucket size
+    # per-input batch dtypes (np.result_type-promoted across tasks, like
+    # the old np.concatenate path); None → take the first task's dtypes
+    dtypes: Optional[list] = field(compare=False, default=None)
     formed_at: float = field(compare=False, default=0.0)
+
+    def stack(self, staging) -> tuple[list, list]:
+        """Copy task rows into padded staging buffers (Runtime thread).
+
+        Returns ``(inputs, buffers)``: the stacked input arrays and the
+        staging buffers to release once outputs are materialized.  A
+        single task already filling its bucket passes through zero-copy
+        (no buffer checked out).
+        """
+        if len(self.task_tensors) == 1 and self.target_rows == self.n_rows:
+            return list(self.task_tensors[0]), []
+        buffers: list = []
+        inputs: list = []
+        for i in range(len(self.task_tensors[0])):
+            first = self.task_tensors[0][i]
+            dtype = self.dtypes[i] if self.dtypes is not None else first.dtype
+            buf = staging.acquire(
+                (self.target_rows, *first.shape[1:]), dtype
+            )
+            buffers.append(buf)
+            off = 0
+            for tensors in self.task_tensors:
+                part = tensors[i]
+                buf[off : off + part.shape[0]] = part
+                off += part.shape[0]
+            if off < self.target_rows:
+                buf[off:] = 0  # recycled buffers hold the previous batch
+            inputs.append(buf)
+        return inputs, buffers
 
 
 @dataclass
@@ -77,12 +121,18 @@ class TaskPool:
         max_batch_size: int = 1024,
         batch_timeout: float = 0.002,
         pad_buckets: bool = True,
+        serial_key: Optional[str] = None,
+        warm_buckets: Sequence[int] | Callable[[], Sequence[int]] = (),
     ):
         self.process_fn = process_fn
         self.name = name
         self.max_batch_size = max_batch_size
         self.batch_timeout = batch_timeout
         self.pad_buckets = pad_buckets
+        # jobs sharing a serial_key are never overlapped by the Runtime's
+        # double buffering (forward and backward of one expert both touch
+        # its params — backward DONATES them); defaults to this pool alone
+        self.serial_key = serial_key if serial_key is not None else name
         self._tasks: asyncio.Queue[_Task] = asyncio.Queue()
         self._carry: Optional[_Task] = None  # oldest task that didn't fit
         self._manager_task: Optional[asyncio.Task] = None
@@ -90,6 +140,13 @@ class TaskPool:
         self.total_rows = 0
         self.padded_rows = 0
         self.batches_formed = 0
+        # per-bucket batch counts: a bucket's FIRST batch compiles an XLA
+        # program (unless AOT-warmed), the rest hit the executable cache.
+        # warm_buckets may be a CALLABLE, resolved live at bucket_stats()
+        # time so warmup performed after pool construction still counts
+        self.bucket_batches: dict[int, int] = {}
+        self.warm_buckets = warm_buckets
+        self.stack_time = 0.0  # accumulated by the Runtime (its thread)
 
     async def submit_task(self, *tensors: np.ndarray) -> list[np.ndarray]:
         """Submit one task (row-batch of tensors); await its outputs."""
@@ -156,15 +213,36 @@ class TaskPool:
                         )
 
     def _dispatch(self, batch: list[_Task], rows: int, runtime) -> None:
+        """Form the job — METADATA ONLY.  No tensor bytes move here: the
+        event loop must stay free to serve other connections while the
+        Runtime thread does the stacking (and overlaps it with the
+        previous batch's device execution)."""
         target = bucket_rows(rows, self.max_batch_size) if self.pad_buckets else rows
-        stacked = []
-        for i in range(len(batch[0].tensors)):
-            parts = [t.tensors[i] for t in batch]
-            arr = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
-            if target > rows:
-                pad = np.zeros((target - rows, *arr.shape[1:]), dtype=arr.dtype)
-                arr = np.concatenate([arr, pad], axis=0)
-            stacked.append(arr)
+        # validate task compatibility up front so a malformed task fails
+        # ITS batch here (old np.concatenate semantics: tail-shape or
+        # arity mismatch raises; dtype differences PROMOTE via
+        # np.result_type, e.g. a stray f64 task widens the batch) instead
+        # of surfacing later as a runtime-side stacking error
+        first = [np.asarray(t) for t in batch[0].tensors]
+        tasks = [tuple(first)]
+        dtypes = [a.dtype for a in first]
+        for t in batch[1:]:
+            if len(t.tensors) != len(first):
+                raise ValueError(
+                    f"task arity {len(t.tensors)} != batch arity {len(first)}"
+                )
+            coerced = []
+            for i, tensor in enumerate(t.tensors):
+                arr = np.asarray(tensor)
+                if arr.shape[1:] != first[i].shape[1:]:
+                    raise ValueError(
+                        f"task tensor {i} is {arr.dtype}{arr.shape}, batch "
+                        f"expects (*, {first[i].shape[1:]})"
+                    )
+                if arr.dtype != dtypes[i]:
+                    dtypes[i] = np.result_type(dtypes[i], arr.dtype)
+                coerced.append(arr)
+            tasks.append(tuple(coerced))
         spans, start = [], 0
         for t in batch:
             spans.append((t.future, start, start + t.n_rows))
@@ -172,13 +250,16 @@ class TaskPool:
         self.total_rows += rows
         self.padded_rows += target - rows
         self.batches_formed += 1
+        self.bucket_batches[target] = self.bucket_batches.get(target, 0) + 1
         job = BatchJob(
             priority=batch[0].arrived,
             seq=next(self._seq),
             pool=self,
-            inputs=tuple(stacked),
+            task_tensors=tasks,
             row_spans=spans,
             n_rows=rows,
+            target_rows=target,
+            dtypes=dtypes,
             formed_at=time.monotonic(),
         )
         runtime.submit(job)
@@ -197,3 +278,19 @@ class TaskPool:
     def padding_waste(self) -> float:
         total = self.total_rows + self.padded_rows
         return self.padded_rows / total if total else 0.0
+
+    def bucket_stats(self) -> dict:
+        """Per-bucket batch counts with compile/hit accounting: a bucket's
+        first batch pays an XLA compile (unless AOT-warmed at startup),
+        every later batch hits the executable cache."""
+        warm = (
+            self.warm_buckets() if callable(self.warm_buckets)
+            else self.warm_buckets
+        )
+        warm = frozenset(int(b) for b in warm)
+        cold = sum(1 for b in self.bucket_batches if b not in warm)
+        return {
+            "batches_per_bucket": dict(sorted(self.bucket_batches.items())),
+            "cold_compiles": cold,
+            "cache_hits": self.batches_formed - cold,
+        }

@@ -13,8 +13,61 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import threading
 from typing import Any, Awaitable, Callable, Optional
+
+if hasattr(asyncio, "timeout"):  # Python >= 3.11
+    asyncio_timeout = asyncio.timeout
+else:
+
+    @contextlib.asynccontextmanager
+    async def asyncio_timeout(delay: Optional[float]):
+        """``asyncio.timeout`` backport for 3.10: cancel the enclosing task
+        after ``delay`` and surface it as builtin ``TimeoutError`` (the
+        3.11+ exception type callers catch).  ``None`` disables the bound.
+
+        3.10 has no ``Task.uncancel`` bookkeeping, so the timer's cancel
+        carries a sentinel message — an EXTERNAL cancellation racing the
+        timer keeps its own message and is re-raised as CancelledError,
+        never mistaken for (or absorbed as) a timeout."""
+        if delay is None:
+            yield
+            return
+        task = asyncio.current_task()
+        assert task is not None, "asyncio_timeout must run inside a task"
+        sentinel = object()
+        timed_out = False
+
+        def _fire() -> None:
+            nonlocal timed_out
+            timed_out = True
+            task.cancel(msg=sentinel)
+
+        def _ours(exc: asyncio.CancelledError) -> bool:
+            return bool(exc.args) and exc.args[0] is sentinel
+
+        handle = asyncio.get_running_loop().call_later(delay, _fire)
+        try:
+            yield
+        except asyncio.CancelledError as e:
+            if timed_out and _ours(e):
+                raise TimeoutError(f"operation exceeded {delay:.3f}s") from None
+            raise
+        else:
+            if timed_out:
+                # late-cancel race: the timer fired after the body's last
+                # await resolved — absorb OUR pending cancellation so it
+                # cannot escape as a stray CancelledError at the caller's
+                # next await (the body DID complete in time); an external
+                # cancel still propagates
+                try:
+                    await asyncio.sleep(0)
+                except asyncio.CancelledError as e:
+                    if not _ours(e):
+                        raise
+        finally:
+            handle.cancel()
 
 
 def switch_to_uvloop() -> asyncio.AbstractEventLoop:

@@ -14,6 +14,7 @@ import asyncio
 import logging
 from typing import Optional, Sequence
 
+from learning_at_home_tpu.utils.asyncio_utils import asyncio_timeout
 from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
     pack_message,
@@ -80,7 +81,7 @@ class ConnectionPool:
             writer = None
             t0 = loop.time()
             try:
-                async with asyncio.timeout(timeout):
+                async with asyncio_timeout(timeout):
                     reader, writer = await self._acquire()
                     await send_frame(writer, pack_message(msg_type, tensors, meta))
                     payload = await recv_frame(reader)

@@ -7,11 +7,21 @@ per-RPC timing spans.  This module provides both:
 - a process-wide :class:`Timeline` of timing spans (bounded ring buffer,
   thread-safe, ~100ns overhead when disabled) used by the RPC client, the
   task pools, and the MoE dispatcher;
+- named **event counters** on the same Timeline (:meth:`Timeline.count`)
+  for hot-path pipeline telemetry — overlapped dispatches, staging-buffer
+  reuse, per-bucket cache hits — where a duration span is the wrong shape;
 - :func:`device_trace`, a thin wrapper over ``jax.profiler.trace`` that
   captures an XLA/TensorBoard trace directory for the jitted compute.
 
-Enable span collection with ``LAH_PROFILE=1`` in the environment or
-``timeline.enable()``; read results with ``timeline.summary()``.
+Enable collection with ``LAH_PROFILE=1`` in the environment or
+``timeline.enable()``; read results with ``timeline.summary()`` /
+``timeline.counters()``.
+
+The server Runtime emits one span per pipeline stage per batch —
+``runtime.stack.<pool>`` (staging-buffer copy), ``runtime.dispatch.<pool>``
+(jitted call dispatch), ``runtime.materialize.<pool>`` (device wait) — plus
+an umbrella ``runtime.<pool>`` span covering dispatch→materialized, so a
+summary shows exactly where hot-path time goes.
 """
 
 from __future__ import annotations
@@ -31,6 +41,7 @@ class Timeline:
 
     def __init__(self, maxlen: int = 100_000):
         self._spans: deque[tuple[str, float, float]] = deque(maxlen=maxlen)
+        self._counters: defaultdict[str, float] = defaultdict(float)
         self._lock = threading.Lock()
         self.enabled = os.environ.get("LAH_PROFILE", "") not in ("", "0")
 
@@ -43,11 +54,26 @@ class Timeline:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._counters.clear()
 
     def record(self, name: str, start: float, duration: float) -> None:
         if self.enabled:
             with self._lock:
                 self._spans.append((name, start, duration))
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        """Accumulate a named event counter (no duration semantics)."""
+        if self.enabled:
+            with self._lock:
+                self._counters[name] += value
+
+    def counters(self, prefix: str = "") -> dict[str, float]:
+        with self._lock:
+            return {
+                name: v
+                for name, v in self._counters.items()
+                if name.startswith(prefix)
+            }
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:

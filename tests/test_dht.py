@@ -358,3 +358,49 @@ def test_join_covers_distant_regions_at_scale():
             await teardown(nodes)
 
     run(main())
+
+
+def test_lookup_strike_eviction_requires_distinct_lookups():
+    """Two-strike lookup eviction: two timeouts from ONE logical event
+    (concurrent lookups whose RPCs were in flight during the same pause)
+    must not evict; a strike from a distinct, later lookup must."""
+    node = DHTNode(node_id=DHTID(2**80))
+    peer = DHTID(2**81)
+    node.routing_table.add_or_update_node(peer, ("127.0.0.1", 1))
+
+    # lookups A and B issued their RPC waves before either strike landed —
+    # one GC pause, two timeouts, ONE logical event: no eviction
+    wave_started = time.monotonic()
+    node._record_lookup_timeout(peer, lookup_id=1, wave_started=wave_started)
+    node._record_lookup_timeout(peer, lookup_id=2, wave_started=wave_started)
+    assert node.routing_table.get_endpoint(peer) is not None
+    assert peer in node._lookup_strikes
+
+    # a later lookup whose wave went out AFTER the strike was recorded
+    # gives the peer a fresh chance; failing it is the real second strike
+    node._record_lookup_timeout(
+        peer, lookup_id=3, wave_started=time.monotonic()
+    )
+    assert node.routing_table.get_endpoint(peer) is None
+    assert peer not in node._lookup_strikes  # eviction cleared the strike
+
+
+def test_lookup_strike_same_lookup_never_evicts():
+    node = DHTNode(node_id=DHTID(2**80))
+    peer = DHTID(2**81)
+    node.routing_table.add_or_update_node(peer, ("127.0.0.1", 1))
+    node._record_lookup_timeout(peer, lookup_id=7, wave_started=time.monotonic())
+    node._record_lookup_timeout(peer, lookup_id=7, wave_started=time.monotonic())
+    assert node.routing_table.get_endpoint(peer) is not None
+
+
+def test_lookup_strikes_cleared_when_node_leaves_table():
+    """A peer that times out once and then leaves the table by ANY path
+    (e.g. maintenance eviction) must not leak its strike entry."""
+    node = DHTNode(node_id=DHTID(2**80))
+    peer = DHTID(2**81)
+    node.routing_table.add_or_update_node(peer, ("127.0.0.1", 1))
+    node._record_lookup_timeout(peer, lookup_id=1, wave_started=time.monotonic())
+    assert peer in node._lookup_strikes
+    node.routing_table.remove_node(peer)  # the maintenance path
+    assert peer not in node._lookup_strikes

@@ -175,6 +175,282 @@ def test_batcher_property_randomized():
         run_pool(main())
 
 
+def test_no_stacking_on_event_loop(monkeypatch):
+    """Regression for the pipelined hot path: per-batch stacking must not
+    run on the asyncio loop thread — no np.concatenate there, and the
+    staging copies happen on the Runtime thread."""
+
+    concat_threads = []
+    real_concatenate = np.concatenate
+
+    def tracking_concatenate(*args, **kwargs):
+        concat_threads.append(threading.current_thread().name)
+        return real_concatenate(*args, **kwargs)
+
+    monkeypatch.setattr(np, "concatenate", tracking_concatenate)
+
+    async def main():
+        def process(inputs):
+            return [inputs[0] * 2]
+
+        pool = TaskPool(process, "p", max_batch_size=64, batch_timeout=0.01)
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        runtime.start()
+        pool.start(runtime)
+        loop_thread = threading.current_thread().name
+        xs = [np.full((3, 2), i, np.float32) for i in range(4)]
+        outs = await asyncio.gather(*(pool.submit_task(x) for x in xs))
+        runtime.shutdown()
+        for i, (out,) in enumerate(outs):
+            np.testing.assert_array_equal(out, xs[i] * 2)
+        assert loop_thread not in concat_threads, (
+            "batch stacking ran on the event loop thread"
+        )
+        # the copies really happened runtime-side, into staging buffers
+        assert runtime.stack_time >= 0.0
+        assert runtime.staging.allocated >= 1
+
+    run_pool(main())
+
+
+def test_staging_buffer_reuse_and_isolation():
+    """Two in-flight batches of the same bucket must not share a staging
+    buffer; once both retire, a later batch reuses one of them."""
+
+    async def main():
+        seen = {"a": [], "b": [], "c": []}
+
+        def mk(name):
+            def process(inputs):
+                seen[name].append(id(inputs[0]))
+                return [inputs[0] + 1]
+
+            return process
+
+        # distinct pools (distinct serial keys) with identical bucket
+        # shapes: the runtime dispatches b while a is still in flight
+        pool_a = TaskPool(mk("a"), "a", max_batch_size=8, batch_timeout=0.0)
+        pool_b = TaskPool(mk("b"), "b", max_batch_size=8, batch_timeout=0.0)
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        # two tasks per pool so each batch stacks (multi-task → staging)
+        xs = [np.full((1, 2), i, np.float32) for i in range(2)]
+        futs = [
+            asyncio.ensure_future(pool.submit_task(x))
+            for pool in (pool_a, pool_b)
+            for x in xs
+        ]
+        await asyncio.sleep(0.01)
+        pool_a.start(runtime)
+        pool_b.start(runtime)
+        await asyncio.sleep(0.05)  # both jobs formed and queued
+        runtime.start()
+        await asyncio.gather(*futs)
+        assert seen["a"] and seen["b"]
+        # in-flight together → disjoint buffers
+        assert not (set(seen["a"]) & set(seen["b"]))
+
+        # a third batch, after both retired, reuses a pooled buffer
+        pool_c = TaskPool(mk("c"), "c", max_batch_size=8, batch_timeout=0.0)
+        pool_c.start(runtime)
+        reused_before = runtime.staging.reused
+        await asyncio.gather(*(pool_c.submit_task(x) for x in xs))
+        runtime.shutdown()
+        assert runtime.staging.reused > reused_before
+        assert set(seen["c"]) <= (set(seen["a"]) | set(seen["b"]))
+
+    run_pool(main())
+
+
+class _LazyArray:
+    """Output whose materialization (np.asarray) is observable — stands in
+    for an async XLA array that blocks when fetched."""
+
+    def __init__(self, value, events, tag):
+        self.value = value
+        self.events = events
+        self.tag = tag
+
+    def __array__(self, dtype=None):
+        self.events.append(("materialize", self.tag))
+        return np.asarray(self.value, dtype)
+
+
+def test_double_buffering_overlap_and_serialization():
+    """Different serial keys: job B dispatches BEFORE job A materializes
+    (the overlap).  Same serial key: A must fully materialize before B
+    dispatches (per-expert update serialization)."""
+
+    def run_pair(key_a, key_b):
+        events = []
+
+        async def main():
+            def mk(tag):
+                def process(inputs):
+                    events.append(("dispatch", tag))
+                    return [_LazyArray(inputs[0], events, tag)]
+
+                return process
+
+            pool_a = TaskPool(mk("A"), "pa", max_batch_size=4,
+                              batch_timeout=0.0, serial_key=key_a)
+            pool_b = TaskPool(mk("B"), "pb", max_batch_size=4,
+                              batch_timeout=0.0, serial_key=key_b)
+            runtime = Runtime()
+            runtime.attach_loop(asyncio.get_running_loop())
+            x = np.ones((1, 2), np.float32)
+            fut_a = asyncio.ensure_future(pool_a.submit_task(x))
+            await asyncio.sleep(0.01)
+            fut_b = asyncio.ensure_future(pool_b.submit_task(x))
+            await asyncio.sleep(0.01)
+            pool_a.start(runtime)
+            pool_b.start(runtime)
+            await asyncio.sleep(0.05)  # both jobs queued before the loop runs
+            runtime.start()
+            await asyncio.gather(fut_a, fut_b)
+            runtime.shutdown()
+            return runtime
+
+        runtime = run_pool(main())
+        return events, runtime
+
+    events, runtime = run_pair("k1", "k2")
+    assert events == [
+        ("dispatch", "A"), ("dispatch", "B"),
+        ("materialize", "A"), ("materialize", "B"),
+    ]
+    assert runtime.jobs_overlapped == 1
+    assert runtime.stats()["overlap_fraction"] == 0.5
+
+    events, runtime = run_pair("same", "same")
+    assert events == [
+        ("dispatch", "A"), ("materialize", "A"),
+        ("dispatch", "B"), ("materialize", "B"),
+    ]
+    assert runtime.jobs_overlapped == 0
+
+
+def test_padding_accounting_parity_and_buckets():
+    """The off-loop path must account padding exactly like the old on-loop
+    path, and track per-bucket compile/hit telemetry."""
+
+    async def main():
+        def process(inputs):
+            return [inputs[0]]
+
+        pool = TaskPool(process, "p", max_batch_size=16, batch_timeout=0.0)
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        runtime.start()
+        pool.start(runtime)
+        # 3 rows → bucket 4 (1 pad row); then 5 rows → bucket 8 (3 pad);
+        # then 3 rows again → bucket 4 is now a cache hit
+        for rows in (3, 5, 3):
+            await pool.submit_task(np.zeros((rows, 2), np.float32))
+        runtime.shutdown()
+        assert pool.total_rows == 11
+        assert pool.padded_rows == (4 - 3) + (8 - 5) + (4 - 3)
+        assert pool.batches_formed == 3
+        assert pool.padding_waste == pool.padded_rows / (11 + pool.padded_rows)
+        bs = pool.bucket_stats()
+        assert bs["batches_per_bucket"] == {4: 2, 8: 1}
+        assert bs["cold_compiles"] == 2 and bs["cache_hits"] == 1
+
+    run_pool(main())
+
+
+def test_stale_padding_rezeroed_on_buffer_reuse():
+    """A recycled staging buffer holds the previous batch's rows — the pad
+    region must read as zeros, not stale data."""
+
+    async def main():
+        pad_sums = []
+
+        def process(inputs):
+            pad_sums.append(float(np.abs(inputs[0][3:]).sum()))  # pad rows
+            return [inputs[0]]
+
+        pool = TaskPool(process, "p", max_batch_size=8, batch_timeout=0.05)
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        runtime.start()
+        pool.start(runtime)
+        # two tasks → stacked batch of 3 rows in a 4-bucket, all ones
+        a, b = np.ones((2, 2), np.float32), np.ones((1, 2), np.float32)
+        await asyncio.gather(pool.submit_task(a), pool.submit_task(b))
+        # same shape again: reuses the dirty buffer
+        await asyncio.gather(pool.submit_task(a), pool.submit_task(b))
+        runtime.shutdown()
+        # every batch's pad region (if any) must read as zeros
+        assert pad_sums and all(s == 0.0 for s in pad_sums)
+        assert runtime.staging.reused >= 1
+
+    run_pool(main())
+
+
+def test_output_aliasing_staging_buffer_is_copied():
+    """A process_fn returning its input (a view of the staging buffer)
+    must not hand clients memory that a later batch will overwrite."""
+
+    async def main():
+        def process(inputs):
+            return [inputs[0]]  # alias of the staging buffer
+
+        pool = TaskPool(process, "p", max_batch_size=8, batch_timeout=0.05)
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        runtime.start()
+        pool.start(runtime)
+        a = np.full((2, 2), 7.0, np.float32)
+        b = np.full((1, 2), 9.0, np.float32)
+        (out_a,), (out_b,) = await asyncio.gather(
+            pool.submit_task(a), pool.submit_task(b)
+        )
+        # overwrite the same bucket with different values
+        c = np.full((3, 2), -1.0, np.float32)
+        await pool.submit_task(c)
+        runtime.shutdown()
+        np.testing.assert_array_equal(out_a, a)
+        np.testing.assert_array_equal(out_b, b)
+
+    run_pool(main())
+
+
+def test_mixed_dtype_tasks_promote_like_concatenate():
+    """Old-path parity: co-batched tasks of different float dtypes promote
+    via np.result_type (f32 + f64 → f64 batch), they do not fail the
+    innocent co-batched request."""
+
+    async def main():
+        seen_dtypes = []
+
+        def process(inputs):
+            seen_dtypes.append(inputs[0].dtype)
+            return [inputs[0] * 2]
+
+        pool = TaskPool(process, "p", max_batch_size=8, batch_timeout=0.05)
+        runtime = Runtime()
+        runtime.attach_loop(asyncio.get_running_loop())
+        runtime.start()
+        pool.start(runtime)
+        a = np.ones((2, 2), np.float32)
+        b = np.ones((1, 2), np.float64)
+        (out_a,), (out_b,) = await asyncio.gather(
+            pool.submit_task(a), pool.submit_task(b)
+        )
+        runtime.shutdown()
+        np.testing.assert_array_equal(out_a, a * 2)
+        np.testing.assert_array_equal(out_b, b * 2)
+        # both puts land on the loop before the manager wakes, and the
+        # 50 ms grace window dwarfs a loop tick: the tasks co-batch, and
+        # the mixed batch must have promoted to f64 (concatenate parity)
+        assert pool.batches_formed == 1, seen_dtypes
+        assert seen_dtypes == [np.float64]
+
+    run_pool(main())
+
+
 def test_many_concurrent_clients_stress():
     async def main():
         def process(inputs):

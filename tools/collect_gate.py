@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Fast import-breakage gate: fail in seconds if any test module no longer
+imports (e.g. a jax API moved between releases, like the ``jax.shard_map``
+regression) instead of surfacing as tier-1 collection errors minutes in.
+
+Runs ``pytest --collect-only`` on CPU and exits non-zero on any collection
+error.  Wire it before the full suite:
+
+    python tools/collect_gate.py && pytest tests/ ...
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "pytest", "tests/", "-q",
+                "--collect-only", "-p", "no:cacheprovider",
+            ],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=int(os.environ.get("COLLECT_GATE_TIMEOUT_S", "180")),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: pytest --collect-only timed out", file=sys.stderr)
+        return 2
+    tail = "\n".join((r.stdout or "").splitlines()[-15:])
+    if r.returncode != 0:
+        print("collect_gate: FAIL — collection errors:\n", file=sys.stderr)
+        print(tail, file=sys.stderr)
+        print(r.stderr[-2000:], file=sys.stderr)
+        return r.returncode or 1
+    last = tail.splitlines()[-1] if tail else ""
+    print(f"collect_gate: OK — {last.strip()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
