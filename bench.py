@@ -748,6 +748,8 @@ def dispatch_worker() -> None:
         return round(float(np.percentile(times, q)) * 1e3, 2)
 
     hid, rows = 64, 64
+    from learning_at_home_tpu.client.rpc import set_dispatch_mode
+
     with background_server(
         num_experts=4, hidden_dim=hid, expert_prefix="bench", seed=0
     ) as (endpoint, srv):
@@ -756,12 +758,56 @@ def dispatch_worker() -> None:
             in_features=hid, grid_size=(4,), uid_prefix="bench",
             source=source, k_best=2, k_min=2,
         )
-        times = measure(moe, rows, hid, n_dispatch=25, warmup=5)
+        # Same-session A/B over both dispatch regimes (PR 2): alternate
+        # legacy (serialize-on-loop, protocol v1) and pipelined (off-loop
+        # pack-once, vectored writes, v2 mux) in interleaved pairs on the
+        # same process/server, so sandbox load noise hits both arms alike.
+        ab_pairs = 5
+        per_arm = 3
+        by_mode = {"legacy": [], "pipelined": []}
+        set_dispatch_mode("pipelined")
+        measure(moe, rows, hid, n_dispatch=5, warmup=5)  # compile + warm
+        for _ in range(ab_pairs):
+            for mode in ("legacy", "pipelined"):
+                set_dispatch_mode(mode)
+                n0 = len(moe.dispatch_times)
+                measure(moe, rows, hid, n_dispatch=per_arm, warmup=0)
+                by_mode[mode].extend(list(moe.dispatch_times)[n0:])
+        set_dispatch_mode("pipelined")
+        times = np.asarray(by_mode["pipelined"])
+        legacy_p50 = p(np.asarray(by_mode["legacy"]), 50)
         out = {
             "dispatch_p50_ms": p(times, 50),
             "dispatch_p99_ms": p(times, 99),
             "dispatch_rows": rows,
             "dispatch_n": int(times.size),
+            # the legacy arm of the same-session A/B (pre-PR-2 data path);
+            # the RATIO is the code-regression evidence — absolute CPU
+            # latencies swing ±35% across sandbox sessions (BASELINE.md)
+            "dispatch_p50_ms_legacy": legacy_p50,
+            "dispatch_vs_legacy": round(p(times, 50) / legacy_p50, 3)
+            if legacy_p50 else None,
+            "dispatch_ab_pairs": ab_pairs,
+        }
+        # client hot-path counters: serialize-vs-wait breakdown, bytes the
+        # pack-once fan-out did not re-encode, mux in-flight depth
+        out.update({
+            f"client_{k}": v for k, v in moe.dispatch_stats().items()
+        })
+        # wire-compressed segment: the pack-once savings counter is only
+        # meaningful when a wire dtype makes the downcast shareable (the
+        # headline f32 regime honestly reports 0 saved)
+        moe_bf16 = RemoteMixtureOfExperts(
+            in_features=hid, grid_size=(4,), uid_prefix="bench",
+            source=source, k_best=2, k_min=2, wire_dtype="bfloat16",
+        )
+        bf16_times = measure(moe_bf16, rows, hid, n_dispatch=8, warmup=2)
+        st = moe_bf16.dispatch_stats()
+        out["client_bf16"] = {
+            "dispatch_p50_ms": p(bf16_times, 50),
+            "pack_once_bytes_saved": st["pack_once_bytes_saved"],
+            "pack_bytes": st["pack_bytes"],
+            "pack_p50_ms": st["pack_p50_ms"],
         }
         # hot-path pipeline telemetry (ISSUE 1): the gain is measured,
         # not asserted — overlap fraction, off-loop stacking cost,
@@ -855,23 +901,53 @@ def dispatch_worker() -> None:
         source = StaticExpertSource(
             {f"benchl.{i}": endpoint for i in range(n_experts_l)}
         )
-        for wire, field in ((None, "dispatch_p50_ms_large"),
-                            ("bfloat16", "dispatch_p50_ms_large_bf16")):
+        def make_moe_l(wire):
             # generous timeouts: on a loaded 1-core box the server's
             # first backward-bucket compiles can exceed the default 30 s,
             # and a timeout mid-compile cascades into cancelled quorums
             # instead of one slow warmup dispatch (excluded anyway)
-            moe = RemoteMixtureOfExperts(
+            return RemoteMixtureOfExperts(
                 in_features=hid_l, grid_size=(n_experts_l,),
                 uid_prefix="benchl", source=source, k_best=2, k_min=2,
                 wire_dtype=wire, forward_timeout=90.0,
                 backward_timeout=90.0, timeout_after_k_min=30.0,
             )
-            times = measure(moe, rows_l, hid_l, n_dispatch=10, warmup=3,
-                            seed=2, forward_only=True)
-            out[field] = p(times, 50)
-            out[field.replace("_p50_ms", "_n")] = int(times.size)
+
+        set_dispatch_mode("pipelined")
+        moe_l = make_moe_l(None)
+        times = measure(moe_l, rows_l, hid_l, n_dispatch=10, warmup=3,
+                        seed=2, forward_only=True)
+        out["dispatch_p50_ms_large"] = p(times, 50)
+        out["dispatch_n_large"] = int(times.size)
+        # bf16-wire A/B in INTERLEAVED pairs (the small-regime
+        # methodology): the 2 MB-payload regime is where off-loop
+        # pack-once serialization bites, and sandbox load swings must
+        # hit both arms alike — sequential arms measured box noise
+        moe_ab = {m: make_moe_l("bfloat16") for m in ("pipelined", "legacy")}
+        for mode, m in moe_ab.items():
+            set_dispatch_mode(mode)
+            measure(m, rows_l, hid_l, n_dispatch=2, warmup=2,
+                    seed=2, forward_only=True)  # warm both arms' buckets
+        for _ in range(5):
+            for mode, m in moe_ab.items():
+                set_dispatch_mode(mode)
+                measure(m, rows_l, hid_l, n_dispatch=1, warmup=0,
+                        seed=2, forward_only=True)
+        pipe_t = np.asarray(moe_ab["pipelined"].dispatch_times)[2:]
+        leg_t = np.asarray(moe_ab["legacy"].dispatch_times)[2:]
+        out["dispatch_p50_ms_large_bf16"] = p(pipe_t, 50)
+        out["dispatch_n_large_bf16"] = int(pipe_t.size)
+        out["dispatch_p50_ms_large_bf16_legacy"] = p(leg_t, 50)
+        out["dispatch_large_vs_legacy"] = round(
+            p(pipe_t, 50) / p(leg_t, 50), 3
+        )
+        st = moe_ab["pipelined"].dispatch_stats()
+        out["client_large_pack_once_bytes_saved"] = (
+            st["pack_once_bytes_saved"]
+        )
+        out["client_large_pack_p50_ms"] = st["pack_p50_ms"]
         out["dispatch_rows_large"] = rows_l
+        set_dispatch_mode("pipelined")
     finally:
         proc.terminate()
         try:

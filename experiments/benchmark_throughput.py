@@ -129,6 +129,23 @@ def main():
             "transport": args.transport,
             "chaos": vars(chaos) if chaos else None,
         }
+        # client dispatch hot path (PR 2): negotiated protocol, bytes
+        # handed to the wire, and the multiplexed in-flight high-water
+        # mark per endpoint pool
+        from learning_at_home_tpu.client.rpc import (
+            dispatch_mode,
+            pool_registry,
+        )
+
+        pools = pool_registry().pools()
+        result["client"] = {
+            "dispatch_mode": dispatch_mode(),
+            "protocol": "v2" if any(p._proto == 2 for p in pools) else "v1",
+            "bytes_sent": int(sum(p.bytes_sent for p in pools)),
+            "inflight_depth_max": max(
+                (p.inflight_max for p in pools), default=0
+            ),
+        }
         print(json.dumps(result))
     reset_client_rpc()
 

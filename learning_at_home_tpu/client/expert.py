@@ -78,6 +78,10 @@ class RemoteExpert:
         pool = pool_registry().get(self.endpoint)
         return await pool.rpc(msg_type, tensors, meta, timeout=self.timeout)
 
+    async def _rpc_prepared(self, msg_type, wire, meta):
+        pool = pool_registry().get(self.endpoint)
+        return await pool.rpc_prepared(msg_type, wire, meta, timeout=self.timeout)
+
     def _wire_cast(self, arrs) -> list:
         from learning_at_home_tpu.utils.serialization import wire_cast
 
@@ -88,26 +92,37 @@ class RemoteExpert:
             meta["wire"] = self.wire_dtype
         return meta
 
+    def _call_blocking(self, msg_type: str, tensors, meta: dict):
+        """One exchange with serialization on THIS thread (pipelined
+        mode): the wire cast above and the spec/blob walk both run on the
+        host thread already blocked inside io_callback, so the shared
+        ``lah-client`` loop only writes ready buffers.  Legacy mode keeps
+        the old serialize-on-the-loop path (the bench A/B baseline)."""
+        from learning_at_home_tpu.client.rpc import dispatch_mode
+
+        if dispatch_mode() == "pipelined":
+            from learning_at_home_tpu.utils.serialization import WireTensors
+
+            wire = WireTensors.prepare(tensors)
+            out, _ = client_loop().run(self._rpc_prepared(msg_type, wire, meta))
+        else:
+            out, _ = client_loop().run(self._rpc(msg_type, tensors, meta))
+        return out
+
     def forward_blocking(self, inputs: Sequence[np.ndarray]) -> list[np.ndarray]:
-        tensors, _ = client_loop().run(
-            self._rpc(
-                "forward", self._wire_cast(inputs),
-                self._wire_meta({"uid": self.uid}),
-            )
+        return self._call_blocking(
+            "forward", self._wire_cast(inputs),
+            self._wire_meta({"uid": self.uid}),
         )
-        return tensors
 
     def backward_blocking(
         self, inputs: Sequence[np.ndarray], grad_outputs: Sequence[np.ndarray]
     ) -> list[np.ndarray]:
-        tensors, _ = client_loop().run(
-            self._rpc(
-                "backward",
-                self._wire_cast([*inputs, *grad_outputs]),
-                self._wire_meta({"uid": self.uid, "n_inputs": len(inputs)}),
-            )
+        return self._call_blocking(
+            "backward",
+            self._wire_cast([*inputs, *grad_outputs]),
+            self._wire_meta({"uid": self.uid, "n_inputs": len(inputs)}),
         )
-        return tensors
 
     def info(self) -> dict:
         _, meta = client_loop().run(self._rpc("info", (), {"uid": self.uid}))

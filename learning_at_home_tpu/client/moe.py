@@ -46,8 +46,15 @@ from learning_at_home_tpu.client.routing import (
     filter_valid_uids,
     select_top_k,
 )
-from learning_at_home_tpu.client.rpc import client_loop, pool_registry
-from learning_at_home_tpu.utils.connection import RemoteCallError
+from learning_at_home_tpu.client.rpc import (
+    client_loop,
+    dispatch_mode,
+    pool_registry,
+)
+from learning_at_home_tpu.utils.connection import (
+    QUORUM_STRAGGLER_CANCEL,
+    RemoteCallError,
+)
 from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)
@@ -170,6 +177,15 @@ class RemoteMixtureOfExperts:
         # batch = one optimizer step.
         self.backward_rpcs_sent = 0
         self.backward_rpcs_ok = 0
+        # client hot-path pipeline telemetry (PR 2): host-side serialize
+        # time vs loop round-trip wait per dispatch, bytes handed to the
+        # wire, and the duplicated wire-encoding the pack-once fan-out
+        # avoided (per-call packing downcasts each sample's rows once PER
+        # selected expert; pack-once downcasts the batch once)
+        self.pack_times: deque[float] = deque(maxlen=10_000)
+        self.wait_times: deque[float] = deque(maxlen=10_000)
+        self.pack_bytes = 0
+        self.pack_bytes_saved = 0
 
     # ---- gate parameters ----
 
@@ -322,18 +338,36 @@ class RemoteMixtureOfExperts:
                 else:
                     jobs[e] = (rows, np.full(len(rows), j))
 
+        prepared = None
+        if dispatch_mode() == "pipelined":
+            # payload slot left empty: _prepare_payloads slices each
+            # expert's rows from the ONE wire-cast batch — materializing
+            # x[rows] here too would double the hot-path memcpy
+            uid_jobs, prepared = self._prepare_payloads(
+                "forward",
+                {
+                    alive_uids[e]: (alive[alive_uids[e]], None, rows, slots)
+                    for e, (rows, slots) in jobs.items()
+                },
+                x_full=x,
+            )
+        else:
+            uid_jobs = {
+                alive_uids[e]: (alive[alive_uids[e]], x[rows], rows, slots)
+                for e, (rows, slots) in jobs.items()
+            }
+        t_wait = _time.monotonic()
         results = client_loop().run(
             self._quorum_fanout(
                 msg_type="forward",
-                jobs={
-                    alive_uids[e]: (alive[alive_uids[e]], x[rows], rows, slots)
-                    for e, (rows, slots) in jobs.items()
-                },
+                jobs=uid_jobs,
                 batch=batch,
                 quorum=self.k_min,
                 rpc_timeout=self.forward_timeout,
+                prepared=prepared,
             )
         )
+        self.wait_times.append(_time.monotonic() - t_wait)
 
         y = np.zeros((batch, self.k_best, x.shape[1]), x.dtype)
         mask = np.zeros((batch, self.k_best), bool)
@@ -389,6 +423,95 @@ class RemoteMixtureOfExperts:
         self.dispatch_times.append(_time.monotonic() - t0)
         return y, idx, mask, np.int32(cid)
 
+    # ---- host-thread serialization (the off-loop half of the pipeline) ----
+
+    def _prepare_payloads(self, kind: str, uid_jobs: dict,
+                          x_full=None, gy_full=None) -> tuple[dict, dict]:
+        """Serialize the fan-out's payloads ON THIS host thread (the
+        caller is already blocked inside io_callback) so the client event
+        loop only writes ready buffers — the client-side mirror of PR 1's
+        no-work-on-the-loop rule.
+
+        Pack-once contract: the wire downcast runs once over the FULL
+        batch (``x`` forward, ``gy`` backward) and every expert's payload
+        is a slice of that one encoding; per-call packing would re-encode
+        each sample's rows once per selected expert (k× the work).  The
+        prepared blobs are immutable and shared across the merged
+        ``multi`` call and any disaggregated per-expert retry.  Backward
+        additionally reuses the forward's already-downcast rows stored in
+        the session — no re-encode at all for the input half.
+
+        Returns ``(jobs, prepared)``: jobs with payload slots replaced by
+        the wire-encoded arrays (sessions then store wire rows), and
+        uid → :class:`WireTensors`.  ``pack_bytes_saved`` accumulates the
+        wire-encode bytes avoided vs per-call packing."""
+        import time as _time
+
+        from learning_at_home_tpu.utils.serialization import (
+            WireTensors,
+            is_float_dtype,
+            wire_cast,
+        )
+
+        t0 = _time.monotonic()
+        wd = self.wire_dtype
+        out_jobs: dict = {}
+        prepared: dict = {}
+        saved = 0
+        if kind == "forward":
+            x_wire = wire_cast([x_full], wd)[0]
+            dup = 0
+            for uid, (ep, _x_rows, rows, slots) in uid_jobs.items():
+                rows_wire = x_wire[rows]
+                dup += rows_wire.nbytes
+                out_jobs[uid] = (ep, rows_wire, rows, slots)
+                prepared[uid] = WireTensors.prepare([rows_wire])
+            if wd is not None:
+                saved = max(0, dup - x_wire.nbytes)
+        else:
+            gy_wire = wire_cast([gy_full], wd)[0]
+            for uid, (ep, x_stored, rows, slots) in uid_jobs.items():
+                x_pay = np.asarray(x_stored)
+                if wd is not None and is_float_dtype(x_pay.dtype):
+                    if x_pay.dtype == np.dtype(wd):
+                        # forward already encoded these rows: reuse them
+                        saved += x_pay.nbytes
+                    else:  # session from a legacy-mode forward
+                        x_pay = wire_cast([x_pay], wd)[0]
+                g_pay = gy_wire[rows, slots]
+                out_jobs[uid] = (ep, x_pay, rows, slots, g_pay)
+                prepared[uid] = WireTensors.prepare([x_pay, g_pay])
+        dt = _time.monotonic() - t0
+        nbytes = sum(p.nbytes for p in prepared.values())
+        self.pack_times.append(dt)
+        self.pack_bytes += nbytes
+        self.pack_bytes_saved += saved
+        timeline.record(f"client.pack.{kind}", t0, dt)
+        timeline.count("client.pack.bytes", nbytes)
+        timeline.count("client.pack_once.bytes_saved", saved)
+        return out_jobs, prepared
+
+    def dispatch_stats(self) -> dict:
+        """Client hot-path counters for benchmarks/telemetry: serialize
+        vs wait breakdown, bytes on the wire, pack-once savings, and the
+        per-pool multiplexed in-flight high-water mark."""
+        def p50_ms(d):
+            arr = np.asarray(d)
+            return round(float(np.percentile(arr, 50)) * 1e3, 3) if arr.size else None
+
+        pools = pool_registry().pools()
+        return {
+            "pack_p50_ms": p50_ms(self.pack_times),
+            "wait_p50_ms": p50_ms(self.wait_times),
+            "pack_bytes": int(self.pack_bytes),
+            "pack_once_bytes_saved": int(self.pack_bytes_saved),
+            "bytes_sent": int(sum(p.bytes_sent for p in pools)),
+            "inflight_depth_max": max(
+                (p.inflight_max for p in pools), default=0
+            ),
+            "protocol": "v2" if any(p._proto == 2 for p in pools) else "v1",
+        }
+
     # ---- host side: backward fan-out to exactly the responders ----
 
     def _host_backward(self, cid, gy):
@@ -404,18 +527,30 @@ class RemoteMixtureOfExperts:
         batch = gy.shape[0]
         with self._sessions_lock:
             self.backward_rpcs_sent += len(session)
+        prepared = None
+        if dispatch_mode() == "pipelined":
+            uid_jobs, prepared = self._prepare_payloads(
+                "backward", session, gy_full=gy
+            )
+        else:
+            uid_jobs = {
+                uid: (ep, x_rows, rows, slots, gy[rows, slots])
+                for uid, (ep, x_rows, rows, slots) in session.items()
+            }
+        import time as _time
+
+        t_wait = _time.monotonic()
         results = client_loop().run(
             self._quorum_fanout(
                 msg_type="backward",
-                jobs={
-                    uid: (ep, x_rows, rows, slots, gy[rows, slots])
-                    for uid, (ep, x_rows, rows, slots) in session.items()
-                },
+                jobs=uid_jobs,
                 batch=batch,
                 quorum=self.backward_k_min,
                 rpc_timeout=self.backward_timeout,
+                prepared=prepared,
             )
         )
+        self.wait_times.append(_time.monotonic() - t_wait)
         gx = np.zeros((batch, gy.shape[-1]), gy.dtype)
         ok = np.zeros(batch, np.int64)
         with self._sessions_lock:
@@ -464,7 +599,8 @@ class RemoteMixtureOfExperts:
     # ---- the k-of-n gather loop (shared by forward and backward) ----
 
     async def _quorum_fanout(
-        self, msg_type: str, jobs: dict, batch: int, quorum: int, rpc_timeout: float
+        self, msg_type: str, jobs: dict, batch: int, quorum: int,
+        rpc_timeout: float, prepared: Optional[dict] = None,
     ) -> dict:
         """Run the fan-out in parallel; once every sample has ≥ quorum
         successful replies, wait a grace period then cancel stragglers (the
@@ -474,7 +610,12 @@ class RemoteMixtureOfExperts:
         ``multi`` request (per-part replies) — per-request overhead is paid
         per peer, not per expert, and the failure/straggler granularity
         this coarsens to is the real one: co-hosted experts share a
-        process, so they die (and straggle) together anyway."""
+        process, so they die (and straggle) together anyway.
+
+        ``prepared`` (pipelined mode) maps uid → WireTensors serialized on
+        the host thread; this coroutine then never casts or packs tensor
+        bytes on the loop — merged calls concatenate blob REFERENCES, and
+        a disaggregated retry reuses the same buffers."""
         loop = asyncio.get_running_loop()
         registry = pool_registry()
         groups: dict = {}  # endpoint -> [uid, ...]
@@ -495,12 +636,6 @@ class RemoteMixtureOfExperts:
             return wire_cast([arr], self.wire_dtype)[0]
 
         async def call_single(endpoint, uid) -> dict:
-            job = jobs[uid]
-            payload = (
-                [cast(job[1])]
-                if msg_type == "forward"
-                else [cast(job[1]), cast(job[4])]
-            )
             meta = (
                 {"uid": uid}
                 if msg_type == "forward"
@@ -508,35 +643,61 @@ class RemoteMixtureOfExperts:
             )
             if self.wire_dtype is not None:
                 meta["wire"] = self.wire_dtype
-            tensors, _ = await registry.get(endpoint).rpc(
-                msg_type, payload, meta, timeout=rpc_timeout
-            )
+            pool = registry.get(endpoint)
+            if prepared is not None:
+                tensors, _ = await pool.rpc_prepared(
+                    msg_type, prepared[uid], meta, timeout=rpc_timeout
+                )
+            else:
+                job = jobs[uid]
+                payload = (
+                    [cast(job[1])]
+                    if msg_type == "forward"
+                    else [cast(job[1]), cast(job[4])]
+                )
+                tensors, _ = await pool.rpc(
+                    msg_type, payload, meta, timeout=rpc_timeout
+                )
             return {uid: tensors}
 
         async def call_group(endpoint, uids) -> dict:
             """Returns uid -> reply tensors (None for failed parts)."""
             if len(uids) == 1:
                 return await call_single(endpoint, uids[0])
-            parts, payload = [], []
+            n_payload = 1 if msg_type == "forward" else 2
+            parts = []
             for uid in uids:
-                job = jobs[uid]
-                t = (
-                    [cast(job[1])]
-                    if msg_type == "forward"
-                    else [cast(job[1]), cast(job[4])]
-                )
-                part = {"uid": uid, "n_tensors": len(t)}
+                part = {"uid": uid, "n_tensors": n_payload}
                 if msg_type == "backward":
                     part["n_inputs"] = 1
                 parts.append(part)
-                payload.extend(t)
             multi_meta = {"op": msg_type, "parts": parts}
             if self.wire_dtype is not None:
                 multi_meta["wire"] = self.wire_dtype
-            reply_tensors, reply_meta = await registry.get(endpoint).rpc(
-                "multi", payload, multi_meta,
-                timeout=rpc_timeout,
-            )
+            pool = registry.get(endpoint)
+            if prepared is not None:
+                from learning_at_home_tpu.utils.serialization import (
+                    WireTensors,
+                )
+
+                # spec/blob reference concat — the per-uid buffers packed
+                # once on the host thread serve the merged request as-is
+                wire = WireTensors.concat([prepared[uid] for uid in uids])
+                reply_tensors, reply_meta = await pool.rpc_prepared(
+                    "multi", wire, multi_meta, timeout=rpc_timeout
+                )
+            else:
+                payload = []
+                for uid in uids:
+                    job = jobs[uid]
+                    payload.extend(
+                        [cast(job[1])]
+                        if msg_type == "forward"
+                        else [cast(job[1]), cast(job[4])]
+                    )
+                reply_tensors, reply_meta = await pool.rpc(
+                    "multi", payload, multi_meta, timeout=rpc_timeout
+                )
             # reply meta is peer-supplied: any structural lie fails the
             # whole group (equivalent to a failed RPC), never misbinds
             rparts = reply_meta.get("parts")
@@ -654,5 +815,9 @@ class RemoteMixtureOfExperts:
                 if settled.all():
                     deadline = loop.time() + self.timeout_after_k_min
         for task in pending:
-            task.cancel()
+            # explicit marker (NOT an elapsed-time heuristic): the pool
+            # folds the straggler's elapsed wait into its RTT EMA however
+            # short the configured grace period, while unmarked teardown
+            # cancels are never mistaken for slowness (ADVICE r5 item 3)
+            task.cancel(msg=QUORUM_STRAGGLER_CANCEL)
         return results

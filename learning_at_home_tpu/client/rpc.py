@@ -7,16 +7,45 @@ the TPU-build replacement for the reference's thread-per-call dispatch.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
-from learning_at_home_tpu.utils.connection import PoolRegistry
+from learning_at_home_tpu.utils.connection import PoolRegistry, force_protocol_v1
 
 _lock = threading.Lock()
 _loop: Optional[BackgroundLoop] = None
 _registry: Optional[PoolRegistry] = None
 _sync_dispatch_set = False
+
+# Dispatch data-path regime.  "pipelined" (default): serialization happens
+# on the caller's host thread (pack-once fan-out, WireTensors), frames go
+# out via vectored writes, and connections negotiate protocol v2
+# multiplexing.  "legacy": the pre-PR-2 path — per-call wire_cast +
+# pack_message ON the client event loop, one RPC per socket (protocol v1
+# forced).  Kept alive as the same-session A/B baseline (bench.py) and as
+# an escape hatch (LAH_CLIENT_PIPELINE=0).
+_dispatch_mode = (
+    "legacy"
+    if os.environ.get("LAH_CLIENT_PIPELINE", "1") in ("0", "legacy")
+    else "pipelined"
+)
+if _dispatch_mode == "legacy":
+    force_protocol_v1(True)
+
+
+def dispatch_mode() -> str:
+    return _dispatch_mode
+
+
+def set_dispatch_mode(mode: str) -> None:
+    """Switch the client dispatch regime at runtime (bench A/B)."""
+    global _dispatch_mode
+    if mode not in ("pipelined", "legacy"):
+        raise ValueError(f"dispatch mode must be pipelined|legacy, got {mode!r}")
+    _dispatch_mode = mode
+    force_protocol_v1(mode == "legacy")
 
 
 def ensure_sync_cpu_dispatch() -> None:

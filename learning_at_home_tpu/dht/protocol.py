@@ -96,7 +96,11 @@ class DHTProtocol:
         self.storage = storage
         self.rpc_timeout = rpc_timeout
         self.listen_port: Optional[int] = None  # set by DHTNode after bind
-        self._pools = PoolRegistry(max_connections_per_endpoint=2)
+        # v1-pinned: DHT handlers speak their own message schema, not the
+        # tensor-RPC ``hello`` — probing them would break the connection
+        self._pools = PoolRegistry(
+            max_connections_per_endpoint=2, negotiate_v2=False
+        )
         self._server: Optional[asyncio.base_events.Server] = None
         self._handler_tasks: set[asyncio.Task] = set()
 

@@ -22,6 +22,11 @@ Wire protocol (see utils/serialization.py for framing):
                 server — the swarm fan-out pays per-request overhead per
                 PEER, not per expert (failure granularity is per-peer
                 anyway: co-hosted experts die together).
+- ``hello``:    meta {features: [...]}                    → ``hello_ok``
+                meta {features: intersection} and the connection becomes
+                protocol v2: requests carry a header ``rid`` which the
+                reply echoes, many requests may be in flight, replies
+                arrive in COMPLETION order (docs/PROTOCOL.md).
 - errors                                                  → ``error`` meta {message}
 
 Wire compression: a request whose meta carries ``{"wire": "bfloat16"}``
@@ -43,10 +48,13 @@ import numpy as np
 
 from learning_at_home_tpu.utils.serialization import (
     WIRE_DTYPES,
+    WireTensors,
+    frame_nbytes,
     is_float_dtype,
-    pack_message,
+    pack_frames,
+    peek_header,
     recv_frame,
-    send_frame,
+    send_frame_parts,
     unpack_message,
     wire_cast,
 )
@@ -55,6 +63,13 @@ if TYPE_CHECKING:
     from learning_at_home_tpu.server.server import Server
 
 logger = logging.getLogger(__name__)
+
+# Features the asyncio transport speaks; a client ``hello`` gets back the
+# intersection with what it offered.  The native C++ pump does NOT
+# negotiate (its dispatcher replies through handler._dispatch, where
+# ``hello`` lands in the unknown-message error path), so clients fall
+# back to protocol v1 against it — by design, not by accident.
+SERVER_FEATURES = ("mux",)
 
 
 def upcast_from_wire(tensors, wire: str | None) -> list:
@@ -100,23 +115,79 @@ class ConnectionHandler:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
+        muxed = False  # becomes True after a ``hello`` negotiates v2
+        wlock = asyncio.Lock()  # one frame at a time on the socket
+        inflight: set[asyncio.Task] = set()
         try:
             while True:
                 try:
                     payload = await recv_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break
-                reply = await self._dispatch(payload)
+                try:
+                    msg_type, rid = peek_header(payload)
+                except Exception:
+                    msg_type, rid = None, None  # _dispatch makes the error reply
+                if msg_type == "hello":
+                    # protocol v2 feature negotiation: echo the feature
+                    # subset we speak; the connection is multiplexed from
+                    # here on (request-id-tagged frames, replies in
+                    # completion order)
+                    _, _, hmeta = unpack_message(payload)
+                    offered = hmeta.get("features") or []
+                    common = [f for f in SERVER_FEATURES if f in offered]
+                    muxed = "mux" in common
+                    await self._send(
+                        writer, wlock,
+                        pack_frames(
+                            "hello_ok", WireTensors.prepare(),
+                            {"features": common}, rid=rid,
+                        ),
+                    )
+                    continue
+                if muxed and rid is not None:
+                    # serve concurrently; each reply carries its request id
+                    # so the client can match out-of-order completions
+                    task = asyncio.get_running_loop().create_task(
+                        self._serve_muxed(payload, rid, writer, wlock)
+                    )
+                    inflight.add(task)
+                    task.add_done_callback(inflight.discard)
+                    continue
+                reply = await self._dispatch(payload, rid)
                 if self.server.chaos is not None:
                     if not await self.server.chaos.before_reply(
-                        len(payload) + len(reply)
+                        len(payload) + frame_nbytes(reply) - 4
                     ):
                         continue  # injected drop: client sees a timeout
-                await send_frame(writer, reply)
+                await self._send(writer, wlock, reply)
         except Exception:
             logger.exception("connection handler failed for peer %s", peer)
         finally:
+            for task in inflight:
+                task.cancel()
             writer.close()
+
+    @staticmethod
+    async def _send(writer, wlock: asyncio.Lock, parts: list) -> None:
+        async with wlock:
+            await send_frame_parts(writer, parts)
+
+    async def _serve_muxed(
+        self, payload: bytes, rid: int, writer, wlock: asyncio.Lock
+    ) -> None:
+        try:
+            reply = await self._dispatch(payload, rid)
+            if self.server.chaos is not None:
+                if not await self.server.chaos.before_reply(
+                    len(payload) + frame_nbytes(reply) - 4
+                ):
+                    return  # injected drop: client sees a timeout
+            await self._send(writer, wlock, reply)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("muxed request %d failed", rid)
 
     # ---- per-op execution (validation + pool submit), shared by the
     #      single-expert and multi-expert paths; raises on any failure ----
@@ -175,7 +246,7 @@ class ConnectionHandler:
         result = await self.server.backward_pools[uid].submit_task(*tensors)
         return downcast_to_wire(result, wire)
 
-    async def _run_multi(self, tensors, meta) -> bytes:
+    async def _run_multi(self, tensors, meta, rid=None) -> list:
         """Fan a merged request out to the local expert pools concurrently;
         per-part failures are reported per part, not as a whole-request
         error.  All meta is peer-supplied — validate structurally."""
@@ -226,7 +297,10 @@ class ConnectionHandler:
                     {"uid": uid, "ok": True, "n_tensors": len(result)}
                 )
                 reply_tensors.extend(result)
-        return pack_message("result", reply_tensors, {"parts": reply_parts})
+        return pack_frames(
+            "result", WireTensors.prepare(reply_tensors),
+            {"parts": reply_parts}, rid=rid,
+        )
 
     def _server_stats(self) -> dict:
         """Server-WIDE counters in one round trip (the ``info`` op is
@@ -284,44 +358,54 @@ class ConnectionHandler:
             }
         return stats
 
-    async def _dispatch(self, payload: bytes) -> bytes:
+    async def _dispatch(self, payload: bytes, rid=None) -> list:
+        """Serve one request; returns the reply as vectored frame parts
+        (``pack_frames`` output — header buffer + raw tensor blobs), so
+        the reply payload is never joined into one bytestring on this
+        loop.  ``rid`` (protocol v2) is echoed into the reply header."""
+
+        def reply(msg_type: str, tensors=(), meta=None) -> list:
+            return pack_frames(
+                msg_type, WireTensors.prepare(tensors), meta, rid=rid
+            )
+
         try:
             msg_type, tensors, meta = unpack_message(payload)
         except Exception as e:
-            return pack_message("error", meta={"message": f"malformed request: {e}"})
+            return reply("error", meta={"message": f"malformed request: {e}"})
         uid = meta.get("uid")
         wire = meta.get("wire")
         if wire is not None and wire not in WIRE_DTYPES:
-            return pack_message(
+            return reply(
                 "error",
                 meta={"message": f"unsupported wire dtype {wire!r}; "
                       f"supported: {WIRE_DTYPES}"},
             )
         try:
             if msg_type == "forward":
-                return pack_message(
+                return reply(
                     "result", await self._run_forward(uid, tensors, wire)
                 )
             elif msg_type == "backward":
-                return pack_message(
+                return reply(
                     "result",
                     await self._run_backward(
                         uid, tensors, meta.get("n_inputs"), wire
                     ),
                 )
             elif msg_type == "multi":
-                return await self._run_multi(tensors, meta)
+                return await self._run_multi(tensors, meta, rid)
             elif msg_type == "info":
                 backend = self.server.experts.get(uid)
                 if backend is None:
                     raise ValueError(f"unknown expert uid: {uid!r}")
-                return pack_message("result", meta=backend.get_info())
+                return reply("result", meta=backend.get_info())
             elif msg_type == "stats":
-                return pack_message("result", meta=self._server_stats())
+                return reply("result", meta=self._server_stats())
             else:
-                return pack_message(
+                return reply(
                     "error", meta={"message": f"unknown message type {msg_type!r}"}
                 )
         except Exception as e:
             logger.exception("request %s failed (expert %s)", msg_type, uid)
-            return pack_message("error", meta={"message": f"{type(e).__name__}: {e}"})
+            return reply("error", meta={"message": f"{type(e).__name__}: {e}"})

@@ -236,12 +236,16 @@ class Server:
         chains = self._native_chains  # conn_id -> tail future (this thread only)
 
         async def process(prev, payload: bytes):
+            from learning_at_home_tpu.utils.serialization import frame_payload
+
             if prev is not None:
                 try:
                     await asyncio.wrap_future(prev)
                 except BaseException:
                     pass  # prior request's failure was already logged
-            reply = await handler._dispatch(payload)
+            # the pump's C side frames replies itself: join the vectored
+            # parts back into one payload (no writev through ctypes)
+            reply = frame_payload(await handler._dispatch(payload))
             if self.chaos is not None and not await self.chaos.before_reply(
                 len(payload) + len(reply)
             ):

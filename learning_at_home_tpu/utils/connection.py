@@ -2,24 +2,43 @@
 
 Parity role: the reference's ``hivemind/utils/connection.py`` TCP helpers
 (SURVEY.md §2; unverifiable refs, mount empty).  Here the helpers are a
-small per-endpoint pool of persistent asyncio connections: one RPC in
-flight per connection, extra concurrency opens extra sockets up to
-``max_connections``, idle sockets are reused (no per-call TCP+slow-start
-tax on the dispatch hot path).
+small per-endpoint pool of persistent asyncio connections with two data
+paths:
+
+- **protocol v1** (the original contract): one RPC in flight per
+  connection; extra concurrency opens extra sockets up to
+  ``max_connections``; idle sockets are reused.
+- **protocol v2** (negotiated per connection): request-id-tagged frames
+  multiplex many in-flight RPCs over ONE socket — the fan-out's k calls
+  to a peer share a connection instead of burning k sockets, and replies
+  may interleave in any order.  Negotiation is a single ``hello``
+  exchange on first contact; servers that don't speak it (old builds,
+  the native C++ pump) answer with an ``error`` frame and the pool falls
+  back to v1 transparently, reusing the probe socket.
+
+Serialization is the CALLER's job on the hot path: ``rpc_prepared`` takes
+a :class:`WireTensors` built off-loop (host thread) and the loop only
+writes ready buffers via vectored ``writelines`` — the client-side mirror
+of the server's no-work-on-the-loop rule (PR 1).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import threading
 from typing import Optional, Sequence
 
 from learning_at_home_tpu.utils.asyncio_utils import asyncio_timeout
 from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
-    pack_message,
+    WireTensors,
+    frame_nbytes,
+    pack_frames,
+    peek_header,
     recv_frame,
-    send_frame,
+    send_frame_parts,
     unpack_message,
 )
 
@@ -27,24 +46,130 @@ logger = logging.getLogger(__name__)
 
 Endpoint = tuple[str, int]
 
+# Features this client offers in its ``hello``; a server echoes the subset
+# it speaks.  "mux" = request-id-tagged frames, many RPCs per socket.
+CLIENT_FEATURES = ("mux",)
+
+# Cancellation message the quorum fan-out attaches when it cancels a
+# straggler AFTER the grace period (``task.cancel(msg=...)``).  An
+# explicit marker replaces the old 0.05 s elapsed-time floor: straggler
+# cancels fold their elapsed wait into the RTT EMA however short the
+# configured grace period, and teardown/shutdown cancels (no marker) are
+# never mistaken for slowness evidence however loaded the box is
+# (ADVICE.md round 5, item 3).
+QUORUM_STRAGGLER_CANCEL = "lah-quorum-straggler-cancel"
+
+_force_v1 = False
+
+
+def force_protocol_v1(flag: bool) -> None:
+    """Process-wide v1 pin (the legacy half of the dispatch A/B, and an
+    escape hatch for wire debugging).  ``LAH_PROTO=v1`` does the same
+    from the environment."""
+    global _force_v1
+    _force_v1 = bool(flag)
+
+
+def _v2_enabled() -> bool:
+    return not _force_v1 and os.environ.get("LAH_PROTO", "").lower() != "v1"
+
 
 class RemoteCallError(RuntimeError):
     """The remote peer replied with an error frame."""
 
 
+class _MuxConnection:
+    """One v2 socket carrying many in-flight RPCs.
+
+    A single reader task matches reply frames to pending futures by
+    request id; writes from concurrent RPCs serialize on ``wlock`` (one
+    vectored writelines per frame, never interleaved mid-frame).  All
+    state is touched only from the owning event loop."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader, self.writer = reader, writer
+        self.pending: dict[int, asyncio.Future] = {}
+        self.wlock = asyncio.Lock()
+        self.closed = False
+        self._next_rid = 1
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._read_loop(), name="lah-mux-reader"
+        )
+
+    def next_rid(self) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        return rid
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                payload = await recv_frame(self.reader)
+                try:
+                    _, rid = peek_header(payload)
+                except Exception as e:
+                    raise ConnectionError(f"malformed mux reply header: {e}")
+                fut = self.pending.pop(rid, None) if rid is not None else None
+                if fut is not None and not fut.done():
+                    fut.set_result(payload)
+                # unmatched rid: the request timed out / was cancelled and
+                # already gave up its pending slot — drop the late reply
+        except asyncio.CancelledError:
+            self._fail(ConnectionError("mux connection closed"))
+            raise
+        except Exception as e:
+            self._fail(ConnectionError(f"mux connection lost: {e!r}"))
+
+    def _fail(self, exc: Exception) -> None:
+        self.closed = True
+        self.writer.close()
+        pending, self.pending = self.pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def close(self) -> None:
+        self.closed = True
+        self._reader_task.cancel()
+        self.writer.close()
+
+
 class ConnectionPool:
     """Reusable connections to one endpoint; safe for concurrent rpc()."""
 
-    def __init__(self, endpoint: Endpoint, max_connections: int = 8):
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        max_connections: int = 8,
+        max_inflight: int = 64,
+        negotiate_v2: bool = True,
+    ):
         self.endpoint = endpoint
+        # v1 pin for protocols with their own message schema (the DHT's
+        # handlers don't speak ``hello``; probing them would break the
+        # connection instead of getting a clean error reply)
+        self._negotiate_v2 = negotiate_v2
         self._free: asyncio.Queue = asyncio.Queue()
         self._sem = asyncio.Semaphore(max_connections)
+        # v2 state: protocol is negotiated ONCE per pool (None = never
+        # contacted); the mux connection reconnects lazily after faults
+        self._proto: Optional[int] = None
+        self._mux: Optional[_MuxConnection] = None
+        self._nego_lock: Optional[asyncio.Lock] = None
+        self._mux_sem = asyncio.Semaphore(max_inflight)
+        # hot-path telemetry (always on — plain int adds): multiplexed
+        # in-flight depth high-water mark and bytes handed to the wire
+        self.inflight = 0
+        self.inflight_max = 0
+        self.bytes_sent = 0
         # EMA of successful whole-exchange times (seconds), excluding the
         # local semaphore wait: covers network RTT AND the peer's queueing
         # + compute, so it doubles as a load signal.  Consumed by the
         # MoE's latency-aware expert selection (client/moe.py
         # ``latency_weight``); None until the first success.
         self.rtt_ema: Optional[float] = None
+
+    # ---- shared plumbing ----
 
     async def _acquire(self):
         while not self._free.empty():
@@ -55,58 +180,27 @@ class ConnectionPool:
         host, port = self.endpoint
         return await asyncio.open_connection(host, port)
 
-    async def rpc(
-        self,
-        msg_type: str,
-        tensors: Sequence = (),
-        meta: Optional[dict] = None,
-        timeout: Optional[float] = None,
-    ):
-        """One request/response exchange; returns (tensors, meta).
-
-        ``timeout`` bounds the WHOLE exchange including connection
-        establishment — a black-holed endpoint (dropped SYNs) must not stall
-        the caller for the OS connect timeout."""
-        with timeline.span(f"rpc.{msg_type}"):
-            return await self._rpc_inner(msg_type, tensors, meta, timeout)
-
     def _update_rtt(self, dt: float) -> None:
         self.rtt_ema = (
             dt if self.rtt_ema is None else 0.8 * self.rtt_ema + 0.2 * dt
         )
 
-    async def _rpc_inner(self, msg_type, tensors, meta, timeout):
-        loop = asyncio.get_running_loop()
-        async with self._sem:
-            writer = None
-            t0 = loop.time()
-            try:
-                async with asyncio_timeout(timeout):
-                    reader, writer = await self._acquire()
-                    await send_frame(writer, pack_message(msg_type, tensors, meta))
-                    payload = await recv_frame(reader)
-            except BaseException as e:
-                if writer is not None:
-                    writer.close()  # connection state unknown → do not reuse
-                # timeouts and straggler cancels ARE the slowness signal —
-                # fold the elapsed wait into the EMA or peers slower than
-                # the timeout would never be penalized at all.  Fast
-                # failures (refused connection, reset) say nothing about
-                # latency and must NOT reward a broken peer with a small
-                # EMA — skip those.  Cancels below a small floor are
-                # teardown/shutdown cancellations unrelated to the peer
-                # (a quorum straggler cancel arrives only after the grace
-                # period, well past the floor): folding their near-zero
-                # dt would REWARD a slow peer with an artificially low
-                # EMA and steer latency-aware selection toward it.
-                dt = loop.time() - t0
-                if isinstance(e, TimeoutError) or (
-                    isinstance(e, asyncio.CancelledError) and dt >= 0.05
-                ):
-                    self._update_rtt(dt)
-                raise
-            dt = loop.time() - t0
-            self._free.put_nowait((reader, writer))
+    @staticmethod
+    def _is_latency_signal(e: BaseException) -> bool:
+        """Failures whose elapsed time IS slowness evidence: timeouts and
+        quorum straggler cancels (explicitly marked by the fan-out) fold
+        into the EMA, or peers slower than the timeout would never be
+        penalized at all.  Fast failures (refused connection, reset) say
+        nothing about latency and must NOT reward a broken peer with a
+        small EMA; teardown/shutdown cancellations carry no marker and
+        are unrelated to the peer."""
+        return isinstance(e, TimeoutError) or (
+            isinstance(e, asyncio.CancelledError)
+            and bool(e.args)
+            and e.args[0] == QUORUM_STRAGGLER_CANCEL
+        )
+
+    def _finish(self, payload: bytes, dt: float):
         reply_type, reply_tensors, reply_meta = unpack_message(payload)
         if reply_type == "error":
             # error replies are typically the FASTEST exchanges (no expert
@@ -118,33 +212,257 @@ class ConnectionPool:
         self._update_rtt(dt)
         return reply_tensors, reply_meta
 
+    # ---- public entry points ----
+
+    async def rpc(
+        self,
+        msg_type: str,
+        tensors: Sequence = (),
+        meta: Optional[dict] = None,
+        timeout: Optional[float] = None,
+    ):
+        """One request/response exchange; returns (tensors, meta).
+
+        Serializes ``tensors`` at the await point (i.e. ON the loop when
+        called from it) — fine for control-plane calls; the dispatch hot
+        path prepares off-loop and uses :meth:`rpc_prepared`.
+
+        ``timeout`` bounds the WHOLE exchange including connection
+        establishment — a black-holed endpoint (dropped SYNs) must not
+        stall the caller for the OS connect timeout."""
+        return await self.rpc_prepared(
+            msg_type, WireTensors.prepare(tensors), meta, timeout
+        )
+
+    async def rpc_prepared(
+        self,
+        msg_type: str,
+        wire: WireTensors,
+        meta: Optional[dict] = None,
+        timeout: Optional[float] = None,
+    ):
+        """One exchange from a pre-serialized payload (built off-loop).
+
+        Routes to the multiplexed v2 path when the endpoint negotiated
+        it, the one-RPC-per-socket v1 path otherwise (or when v1 is
+        forced)."""
+        with timeline.span(f"rpc.{msg_type}"):
+            if _v2_enabled() and self._negotiate_v2:
+                if self._proto is None:
+                    await self._negotiate(timeout)
+                if self._proto == 2:
+                    try:
+                        return await self._rpc_mux(msg_type, wire, meta, timeout)
+                    except _ProtocolDowngraded:
+                        pass  # peer restarted as v1 mid-stream: fall through
+            return await self._rpc_v1(msg_type, wire, meta, timeout)
+
+    # ---- protocol v1: one RPC per socket ----
+
+    async def _rpc_v1(self, msg_type, wire, meta, timeout):
+        loop = asyncio.get_running_loop()
+        async with self._sem:
+            writer = None
+            t0 = loop.time()
+            try:
+                async with asyncio_timeout(timeout):
+                    reader, writer = await self._acquire()
+                    parts = pack_frames(msg_type, wire, meta)
+                    self.bytes_sent += frame_nbytes(parts)
+                    await send_frame_parts(writer, parts)
+                    payload = await recv_frame(reader)
+            except BaseException as e:
+                if writer is not None:
+                    writer.close()  # connection state unknown → do not reuse
+                if self._is_latency_signal(e):
+                    self._update_rtt(loop.time() - t0)
+                raise
+            dt = loop.time() - t0
+            self._free.put_nowait((reader, writer))
+        return self._finish(payload, dt)
+
+    # ---- protocol v2: negotiation + multiplexed exchanges ----
+
+    def _lazy_nego_lock(self) -> asyncio.Lock:
+        if self._nego_lock is None:
+            self._nego_lock = asyncio.Lock()
+        return self._nego_lock
+
+    async def _negotiate(self, timeout) -> None:
+        """One ``hello`` exchange decides the pool's protocol.  A v2
+        server echoes the features it speaks (the socket becomes the mux
+        connection); anything else — an ``error`` reply from an old
+        server or the native pump — pins v1, and the probe socket is
+        reused for v1 traffic (its handler already served the error and
+        is waiting for the next frame)."""
+        async with self._lazy_nego_lock():
+            if self._proto is not None:
+                return
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            writer = None
+            try:
+                async with asyncio_timeout(timeout):
+                    reader, writer = await asyncio.open_connection(*self.endpoint)
+                    await send_frame_parts(
+                        writer,
+                        pack_frames(
+                            "hello", WireTensors.prepare(),
+                            {"features": list(CLIENT_FEATURES)},
+                        ),
+                    )
+                    payload = await recv_frame(reader)
+            except BaseException as e:
+                if writer is not None:
+                    writer.close()
+                # a peer too slow to even answer hello is slowness
+                # evidence like any timed-out exchange — fold it, or
+                # black-holed endpoints would never be penalized
+                if self._is_latency_signal(e):
+                    self._update_rtt(loop.time() - t0)
+                raise  # endpoint unreachable/slow: protocol stays unknown
+            try:
+                rtype, _, rmeta = unpack_message(payload)
+            except Exception:
+                writer.close()
+                raise
+            if rtype == "hello_ok" and "mux" in (rmeta.get("features") or []):
+                self._proto = 2
+                self._mux = _MuxConnection(reader, writer)
+            else:
+                self._proto = 1
+                self._free.put_nowait((reader, writer))
+
+    async def _ensure_mux(self) -> _MuxConnection:
+        mux = self._mux
+        if mux is not None and not mux.closed:
+            return mux
+        async with self._lazy_nego_lock():
+            if self._mux is not None and not self._mux.closed:
+                return self._mux
+            writer = None
+            try:
+                reader, writer = await asyncio.open_connection(*self.endpoint)
+                await send_frame_parts(
+                    writer,
+                    pack_frames(
+                        "hello", WireTensors.prepare(),
+                        {"features": list(CLIENT_FEATURES)},
+                    ),
+                )
+                payload = await recv_frame(reader)
+                rtype, _, rmeta = unpack_message(payload)
+            except BaseException:
+                # a flapping peer must not leak one FD per reconnect
+                # attempt (_rpc_mux's cleanup only sees mux=None here)
+                if writer is not None:
+                    writer.close()
+                raise
+            if rtype != "hello_ok" or "mux" not in (rmeta.get("features") or []):
+                # the peer restarted as an older build: demote the pool
+                self._proto = 1
+                self._free.put_nowait((reader, writer))
+                raise _ProtocolDowngraded()
+            self._mux = _MuxConnection(reader, writer)
+            return self._mux
+
+    async def _rpc_mux(self, msg_type, wire, meta, timeout):
+        loop = asyncio.get_running_loop()
+        async with self._mux_sem:
+            t0 = loop.time()
+            self.inflight += 1
+            if self.inflight > self.inflight_max:
+                self.inflight_max = self.inflight
+            mux = rid = None
+            try:
+                async with asyncio_timeout(timeout):
+                    mux = await self._ensure_mux()
+                    rid = mux.next_rid()
+                    fut = loop.create_future()
+                    mux.pending[rid] = fut
+                    parts = pack_frames(msg_type, wire, meta, rid=rid)
+                    self.bytes_sent += frame_nbytes(parts)
+                    async with mux.wlock:
+                        await send_frame_parts(mux.writer, parts)
+                    payload = await fut
+            except _ProtocolDowngraded:
+                raise
+            except BaseException as e:
+                if mux is not None and rid is not None:
+                    mux.pending.pop(rid, None)
+                if isinstance(e, (ConnectionError, OSError)) and mux is not None:
+                    # a broken mux socket fails every rider; drop it so the
+                    # next request reconnects (and re-hellos)
+                    mux.close()
+                    if self._mux is mux:
+                        self._mux = None
+                if self._is_latency_signal(e):
+                    self._update_rtt(loop.time() - t0)
+                raise
+            finally:
+                self.inflight -= 1
+            return self._finish(payload, loop.time() - t0)
+
     def close(self) -> None:
         while not self._free.empty():
             _, writer = self._free.get_nowait()
             writer.close()
+        if self._mux is not None:
+            self._mux.close()
+            self._mux = None
+
+
+class _ProtocolDowngraded(Exception):
+    """Internal: the peer no longer speaks v2; retry the exchange on v1."""
 
 
 class PoolRegistry:
-    """endpoint → ConnectionPool map shared by all client stubs on a loop."""
+    """endpoint → ConnectionPool map shared by all client stubs on a loop.
 
-    def __init__(self, max_connections_per_endpoint: int = 8):
+    ``get`` may be called from the event loop AND from host threads (the
+    blocking client paths resolve their pool before entering the loop),
+    so creation is guarded by a lock — without it two racing first-contact
+    ``get``\\s could register two pools for one endpoint, with RTT-EMA
+    updates landing on the orphan (the race ``peek``'s docstring used to
+    merely document)."""
+
+    def __init__(
+        self,
+        max_connections_per_endpoint: int = 8,
+        negotiate_v2: bool = True,
+    ):
         self._pools: dict[Endpoint, ConnectionPool] = {}
+        self._lock = threading.Lock()
         self.max_connections = max_connections_per_endpoint
+        self.negotiate_v2 = negotiate_v2
 
     def get(self, endpoint: Endpoint) -> ConnectionPool:
         endpoint = (endpoint[0], int(endpoint[1]))
-        if endpoint not in self._pools:
-            self._pools[endpoint] = ConnectionPool(endpoint, self.max_connections)
-        return self._pools[endpoint]
+        pool = self._pools.get(endpoint)
+        if pool is None:
+            with self._lock:
+                pool = self._pools.get(endpoint)
+                if pool is None:
+                    pool = ConnectionPool(
+                        endpoint, self.max_connections,
+                        negotiate_v2=self.negotiate_v2,
+                    )
+                    self._pools[endpoint] = pool
+        return pool
 
     def peek(self, endpoint: Endpoint) -> Optional[ConnectionPool]:
         """Non-creating lookup: read-only consumers (latency bias) must
-        not instantiate pools for peers that were never contacted, and a
-        host-thread ``get()`` racing the loop thread's could register two
-        pools for one endpoint (EMA updates landing on the orphan)."""
+        not instantiate pools for peers that were never contacted."""
         return self._pools.get((endpoint[0], int(endpoint[1])))
 
+    def pools(self) -> list[ConnectionPool]:
+        """Snapshot of live pools (telemetry readers)."""
+        with self._lock:
+            return list(self._pools.values())
+
     def close(self) -> None:
-        for pool in self._pools.values():
+        with self._lock:
+            pools = list(self._pools.values())
+            self._pools.clear()
+        for pool in pools:
             pool.close()
-        self._pools.clear()

@@ -22,6 +22,16 @@ The server Runtime emits one span per pipeline stage per batch —
 (jitted call dispatch), ``runtime.materialize.<pool>`` (device wait) — plus
 an umbrella ``runtime.<pool>`` span covering dispatch→materialized, so a
 summary shows exactly where hot-path time goes.
+
+The CLIENT dispatch pipeline (PR 2) mirrors this: per-dispatch
+``client.pack.forward`` / ``client.pack.backward`` spans (host-thread
+serialization — off the event loop by construction), counters
+``client.pack.bytes`` and ``client.pack_once.bytes_saved`` (duplicated
+wire-encode bytes the pack-once fan-out avoided), and per-RPC
+``rpc.<msg_type>`` spans covering the on-loop exchange.  The
+serialize-vs-wait breakdown also surfaces without profiling enabled via
+``RemoteMixtureOfExperts.pack_times`` / ``wait_times`` and
+``dispatch_stats()``.
 """
 
 from __future__ import annotations

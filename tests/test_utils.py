@@ -156,21 +156,39 @@ def test_run_forever_restarts():
 def test_unpack_fuzz_never_hangs_or_corrupts():
     """Random mutations of a valid payload must either parse or raise a
     clean error — never crash, hang, or return tensors inconsistent with
-    their declared shape (wire robustness against bit rot / malice)."""
+    their declared shape (wire robustness against bit rot / malice).
+    Covers BOTH frame flavors: plain v1 payloads and protocol-v2
+    request-id-tagged frames (``pack_frames`` with rid), whose headers
+    must also peek cleanly or raise."""
     import random
 
+    from learning_at_home_tpu.utils.serialization import (
+        WireTensors,
+        pack_frames,
+        peek_header,
+    )
+
     rng = random.Random(0)
-    base = bytearray(
-        pack_message(
-            "forward",
-            [np.ones((4, 8), np.float32), np.arange(6, dtype=np.int32)],
-            {"uid": "f.1", "n_inputs": 2},
-        )
+    tensors_in = [np.ones((4, 8), np.float32), np.arange(6, dtype=np.int32)]
+    meta_in = {"uid": "f.1", "n_inputs": 2}
+    v1 = bytearray(pack_message("forward", tensors_in, meta_in))
+    v2 = bytearray(
+        b"".join(
+            bytes(p)
+            for p in pack_frames(
+                "forward", WireTensors.prepare(tensors_in), meta_in, rid=1234
+            )
+        )[4:]  # strip the outer length prefix: fuzz the payload like v1
     )
     for trial in range(300):
+        base = v1 if trial % 2 == 0 else v2
         buf = bytearray(base)
         for _ in range(rng.randint(1, 8)):
             buf[rng.randrange(len(buf))] = rng.randrange(256)
+        try:
+            peek_header(bytes(buf))  # parse-or-raise, never hang/crash
+        except Exception:
+            pass
         try:
             msg_type, tensors, meta = unpack_message(bytes(buf))
         except Exception:

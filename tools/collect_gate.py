@@ -4,9 +4,15 @@ imports (e.g. a jax API moved between releases, like the ``jax.shard_map``
 regression) instead of surfacing as tier-1 collection errors minutes in.
 
 Runs ``pytest --collect-only`` on CPU and exits non-zero on any collection
-error.  Wire it before the full suite:
+error, then a CLIENT-PATH SMOKE: one forward+backward RPC against a local
+server under BOTH wire protocols (legacy/v1 and pipelined/v2), so
+wire-format breakage fails here in seconds instead of ten minutes into
+the tier-1 run.  Wire it before the full suite:
 
     python tools/collect_gate.py && pytest tests/ ...
+
+``--no-smoke`` skips the RPC smoke; ``--smoke-worker`` is the internal
+child mode that actually runs it.
 """
 
 import os
@@ -14,6 +20,70 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def smoke_worker() -> int:
+    """One fwd+bwd RPC per protocol version against an in-process server;
+    numerics must agree across protocols and v2 must actually negotiate."""
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from learning_at_home_tpu.client import RemoteExpert, reset_client_rpc
+    from learning_at_home_tpu.client.rpc import pool_registry, set_dispatch_mode
+    from learning_at_home_tpu.server.server import background_server
+
+    import optax
+
+    with background_server(
+        num_experts=1, hidden_dim=8, expert_prefix="gate", seed=0,
+        optimizer=optax.sgd(0.0),  # frozen params: replies must match
+    ) as (endpoint, _srv):
+        expert = RemoteExpert("gate.0", endpoint, timeout=30.0)
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        g = np.ones((2, 8), np.float32)
+        outs = {}
+        for mode in ("legacy", "pipelined"):
+            set_dispatch_mode(mode)
+            y = expert.forward_blocking([x])[0]
+            gx = expert.backward_blocking([x], [g])[0]
+            assert y.shape == x.shape and gx.shape == x.shape
+            assert np.isfinite(y).all() and np.isfinite(gx).all()
+            outs[mode] = (y, gx)
+        np.testing.assert_allclose(
+            outs["legacy"][0], outs["pipelined"][0], atol=1e-6
+        )
+        np.testing.assert_allclose(  # backward wire path too, not just fwd
+            outs["legacy"][1], outs["pipelined"][1], atol=1e-6
+        )
+        pool = pool_registry().peek(endpoint)
+        assert pool is not None and pool._proto == 2, (
+            f"pipelined mode did not negotiate protocol v2 (got "
+            f"{None if pool is None else pool._proto})"
+        )
+    reset_client_rpc()
+    print("SMOKE_OK protocols=v1,v2")
+    return 0
+
+
+def run_smoke() -> int:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=int(os.environ.get("COLLECT_GATE_TIMEOUT_S", "180")),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: client-path smoke timed out", file=sys.stderr)
+        return 2
+    if r.returncode != 0 or "SMOKE_OK" not in r.stdout:
+        print("collect_gate: FAIL — client-path smoke:", file=sys.stderr)
+        print(r.stdout[-1000:], file=sys.stderr)
+        print(r.stderr[-2000:], file=sys.stderr)
+        return r.returncode or 1
+    print(f"collect_gate: OK — {r.stdout.strip().splitlines()[-1]}")
+    return 0
 
 
 def main() -> int:
@@ -42,8 +112,12 @@ def main() -> int:
         return r.returncode or 1
     last = tail.splitlines()[-1] if tail else ""
     print(f"collect_gate: OK — {last.strip()}")
+    if "--no-smoke" not in sys.argv:
+        return run_smoke()
     return 0
 
 
 if __name__ == "__main__":
+    if "--smoke-worker" in sys.argv:
+        sys.exit(smoke_worker())
     sys.exit(main())
