@@ -314,6 +314,11 @@ def main() -> int:
         disp = run_dispatch_microbench()
         if disp:
             result.update(disp)
+        # trainer-side averaging round latency (ISSUE 3): host/DCN-tier
+        # like dispatch, so CPU numbers are the relevant ones
+        avg = run_averaging_microbench()
+        if avg:
+            result.update(avg)
     print(json.dumps(result), flush=True)
     return 0
 
@@ -963,11 +968,105 @@ def dispatch_worker() -> None:
     print(json.dumps(out), flush=True)
 
 
+def averaging_worker() -> None:
+    """Trainer-side averaging microbench: two in-process peers run
+    ``--avg-rounds`` DHT-matched all-reduce rounds over a trunk-sized
+    pytree; reports round latency percentiles and wire bytes (the
+    ``averaging`` section of the bench JSON)."""
+    import threading
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from learning_at_home_tpu.averaging import (
+        AveragingConfig,
+        DecentralizedAverager,
+    )
+    from learning_at_home_tpu.dht import DHT
+
+    n_rounds = int(os.environ.get("BENCH_AVG_ROUNDS", "5"))
+    n_elems = int(os.environ.get("BENCH_AVG_ELEMS", str(1 << 20)))  # 4 MB f32
+    dht = DHT()
+    cfg = AveragingConfig(min_group_size=2, max_group_size=2,
+                          part_timeout=20.0)
+    peers = [
+        DecentralizedAverager(dht, config=cfg, peer_id=f"bench-{i}")
+        for i in range(2)
+    ]
+    rs = np.random.RandomState(0)
+    trees = [{"trunk": rs.randn(n_elems).astype(np.float32)}
+             for _ in range(2)]
+    errors: list = []
+
+    def run(i):
+        try:
+            for _ in range(n_rounds):
+                trees[i], _info = peers[i].step_round(
+                    trees[i], matchmaking_timeout=60.0
+                )
+        except BaseException as e:
+            errors.append(repr(e))
+
+    try:
+        threads = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        stats = peers[0].stats()
+        out = {
+            "averaging_rounds": stats["rounds"],
+            "averaging_round_p50_ms": stats["round_p50_ms"],
+            "averaging_round_p99_ms": stats["round_p99_ms"],
+            "averaging_bytes_sent": stats["bytes_sent"],
+            "averaging_degraded_rounds": stats["degraded_rounds"],
+            "averaging_tree_bytes": n_elems * 4,
+        }
+        if errors:
+            out["averaging_error"] = errors[0][:200]
+    finally:
+        for p in peers:
+            p.shutdown()
+        dht.shutdown()
+    print(json.dumps(out), flush=True)
+
+
+def run_averaging_microbench(deadline: int = 240) -> dict | None:
+    """Averaging round latency in a scrubbed CPU subprocess; any failure
+    returns None — telemetry must never cost the main artifact."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--averaging-worker"],
+            capture_output=True, text=True, timeout=deadline, cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: averaging microbench timed out", file=sys.stderr)
+        return None
+    result = _last_json_line(r.stdout)
+    if result is None:
+        print(f"bench: averaging microbench rc={r.returncode}, no JSON\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+    return result
+
+
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         worker()
         sys.exit(0)
     if "--dispatch-worker" in sys.argv:
         dispatch_worker()
+        sys.exit(0)
+    if "--averaging-worker" in sys.argv:
+        averaging_worker()
         sys.exit(0)
     sys.exit(main())

@@ -46,6 +46,15 @@ def parse_args():
                    help="compress activation/grad payloads on the wire")
     p.add_argument("--latency-weight", type=float, default=0.0,
                    help="debit expert selection by endpoint RTT EMA")
+    p.add_argument("--averaging", action="store_true",
+                   help="averaging-under-churn scenario: a companion "
+                        "trainer peer averages gate params with this "
+                        "process every --averaging-every steps, and each "
+                        "server-kill event also takes the companion down "
+                        "MID-ROUND — the summary reports the degraded-"
+                        "round fraction alongside expert availability")
+    p.add_argument("--averaging-every", type=int, default=5,
+                   help="steps between averaging rounds")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
 
@@ -103,6 +112,7 @@ def main():
 
     servers: dict[int, subprocess.Popen] = {}
     client_dht = None
+    avg_main = avg_comp = comp_stop = None
     try:  # EVERYTHING incl. launches/discovery: a setup failure or Ctrl-C
         # must never orphan spawned server processes
         for i in range(args.n_servers):
@@ -129,6 +139,56 @@ def main():
         gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
         opt = optax.adam(1e-2)
         opt_state = opt.init(gate)
+
+        # averaging-under-churn: a companion peer with its own gate copy
+        # keeps rendezvousing with this trainer; kill events also take
+        # the companion down mid-round (degraded rounds, never hangs)
+        if args.averaging:
+            import threading
+
+            from learning_at_home_tpu.averaging import (
+                AveragingConfig,
+                AveragingFailed,
+                DecentralizedAverager,
+            )
+
+            avg_cfg = AveragingConfig(
+                prefix="averaging.churn", min_group_size=2,
+                max_group_size=2, part_timeout=2.0, gather_timeout=2.0,
+            )
+            comp_stop = threading.Event()
+            avg_main = DecentralizedAverager(
+                client_dht, config=avg_cfg, peer_id="trainer-main"
+            )
+            avg_comp = DecentralizedAverager(
+                client_dht, config=avg_cfg, peer_id="trainer-peer"
+            )
+            comp_gate = [jax.tree.map(jnp.asarray, gate)]
+
+            def companion_loop():
+                while not comp_stop.is_set():
+                    try:
+                        averaged, info = avg_comp.step_round(
+                            comp_gate[0], matchmaking_timeout=10.0
+                        )
+                        if info.get("died_after_match"):
+                            # the armed ONE-round mid-round death was
+                            # consumed this round; disarm only now (a
+                            # kill event racing the round boundary must
+                            # not be clobbered before it was observed)
+                            avg_comp.debug_die_after_match = False
+                        elif averaged is not None:
+                            comp_gate[0] = averaged
+                    except AveragingFailed:
+                        pass
+                    except Exception:
+                        pass  # churn teardown races are expected here
+                    comp_stop.wait(0.1)
+
+            threading.Thread(
+                target=companion_loop, name="churn-avg-companion",
+                daemon=True,
+            ).start()
 
         # toy regression task: y = roll(x); trains gate + experts jointly
         rs = np.random.RandomState(args.seed)
@@ -169,6 +229,10 @@ def main():
                 if v not in down and len(down) < min(args.max_down, args.n_servers - 1):
                     servers[v].terminate()
                     dead_since[v] = step
+                    if avg_comp is not None:
+                        # churn hits the averaging tier too: the
+                        # companion dies mid-round on this kill event
+                        avg_comp.debug_die_after_match = True
                     print(json.dumps({"event": "kill", "server": v, "step": step}),
                           flush=True)
                 victim += 1
@@ -201,6 +265,23 @@ def main():
                                   "error": str(e)[-160:]}), flush=True)
                 time.sleep(0.25)
                 continue
+            if (
+                avg_main is not None
+                and step > 0 and step % args.averaging_every == 0
+            ):
+                try:
+                    averaged, avg_info = avg_main.step_round(
+                        gate, matchmaking_timeout=8.0
+                    )
+                    if averaged is not None:
+                        gate = averaged
+                    if avg_info.get("degraded"):
+                        print(json.dumps({"event": "averaging_degraded",
+                                          "step": step}), flush=True)
+                except Exception as e:  # matchmaking failure: keep training
+                    print(json.dumps({"event": "averaging_skipped",
+                                      "step": step,
+                                      "error": str(e)[-120:]}), flush=True)
             if step % 5 == 0 or step == args.steps - 1:
                 print(
                     json.dumps(
@@ -216,19 +297,29 @@ def main():
                 )
 
         p50 = float(np.median(list(moe.dispatch_times)) * 1000)
-        print(
-            json.dumps(
-                {
-                    "metric": "churn summary",
-                    "steps": args.steps,
-                    "quorum_failures": quorum_failures,
-                    "quorum_success_rate": round(1 - quorum_failures / args.steps, 4),
-                    "dispatch_p50_ms": round(p50, 2),
-                }
-            ),
-            flush=True,
-        )
+        summary = {
+            "metric": "churn summary",
+            "steps": args.steps,
+            "quorum_failures": quorum_failures,
+            "quorum_success_rate": round(1 - quorum_failures / args.steps, 4),
+            "dispatch_p50_ms": round(p50, 2),
+        }
+        if avg_main is not None:
+            s = avg_main.stats()
+            summary["averaging_rounds"] = s["rounds"]
+            summary["averaging_degraded_fraction"] = round(
+                s["degraded_rounds"] / max(1, s["rounds"]), 4
+            )
+            summary["averaging_matchmaking_failures"] = (
+                s["matchmaking_failures"]
+            )
+        print(json.dumps(summary), flush=True)
     finally:
+        if comp_stop is not None:
+            comp_stop.set()
+        for averager in (avg_main, avg_comp):
+            if averager is not None:
+                averager.shutdown()
         for proc in servers.values():
             proc.terminate()
         for proc in servers.values():

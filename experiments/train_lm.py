@@ -107,6 +107,24 @@ def parse_args():
         "expert-choice (each expert picks top-C tokens; balanced by "
         "construction, no jitter/aux needed)",
     )
+    p.add_argument("--averaging", action="store_true",
+                   help="swarm mode: decentralized trunk/gate parameter "
+                        "averaging across trainers (DHT-matched group "
+                        "all-reduce; learning_at_home_tpu/averaging). "
+                        "Sequential trainers run a BLOCKING round every "
+                        "--averaging-every steps (params replaced by the "
+                        "group mean); pipelined trainers average in the "
+                        "background and apply the group delta atomically. "
+                        "A final blocking round runs after training, so "
+                        "co-scheduled trainers end with identical trunks")
+    p.add_argument("--averaging-every", type=int, default=10,
+                   help="steps between averaging rounds")
+    p.add_argument("--averaging-group-size", type=int, default=2,
+                   help="minimum trainers per averaging round")
+    p.add_argument("--averaging-timeout", type=float, default=30.0,
+                   help="matchmaking budget per round (s); a round that "
+                        "finds no group is skipped and counted, never "
+                        "fatal")
     p.add_argument("--wire-dtype", default=None,
                    choices=["bfloat16", "float16"],
                    help="swarm mode: downcast activation/grad RPC payloads "
@@ -126,6 +144,9 @@ def parse_args():
     if args.n_trainers > 1 and args.mode != "swarm":
         p.error("--n-trainers requires --mode swarm (pod mode is one "
                 "jitted SPMD trainer; concurrency there is the mesh)")
+    if args.averaging and args.mode != "swarm":
+        p.error("--averaging requires --mode swarm (pod mode's trunk is "
+                "one SPMD program — it cannot diverge)")
     return args
 
 
@@ -450,6 +471,28 @@ def run_swarm(args):
     opt_state = optimizer.init(params)
     step_fn = model.make_train_step(optimizer)
 
+    avg_session = None
+    if args.averaging:
+        from learning_at_home_tpu.averaging import (
+            AveragingConfig,
+            AveragingSession,
+            DecentralizedAverager,
+        )
+
+        averager = DecentralizedAverager(
+            client_dht,
+            config=AveragingConfig(
+                prefix="averaging.trunk",
+                min_group_size=args.averaging_group_size,
+                matchmaking_timeout=args.averaging_timeout,
+            ),
+        )
+        avg_session = AveragingSession(
+            averager, every_steps=args.averaging_every
+        )
+        print(f"# averaging peer {averager.peer_id} on "
+              f"{averager.endpoint[0]}:{averager.endpoint[1]}", flush=True)
+
     # client-side recovery (§5.4): the trainer's trunk+gate params resume
     # from a checkpoint; expert params recover via the SERVER's per-expert
     # checkpoints (server --resume) — two halves of one contract
@@ -513,6 +556,10 @@ def run_swarm(args):
             trainer = PipelinedSwarmTrainer(
                 model, optimizer, params, opt_state, n_workers=args.pipeline
             )
+            if avg_session is not None:
+                # background rounds: snapshot under the apply lock, apply
+                # the group delta atomically (delayed-update tolerant)
+                trainer.attach_averaging(avg_session)
 
             def on_log(entry):
                 p50 = dispatch_p50()
@@ -534,10 +581,16 @@ def run_swarm(args):
                 log_every=args.log_every, on_log=on_log,
                 tokens_per_batch=args.batch_size * args.seq_len,
             )
+            if avg_session is not None:
+                # a background round may still be applying its delta to
+                # trainer.params; read params only once it settled, or
+                # the final blocking round would feed (and the
+                # checkpoint would keep) the stale pre-delta copy
+                avg_session.wait_idle()
             params, opt_state = trainer.params, trainer.opt_state
             p50 = dispatch_p50()
             sent, acked = backward_rpcs()
-            print(json.dumps({
+            summary_json = {
                 "pipeline": args.pipeline,
                 "tokens_per_sec": round(summary["tokens_per_sec"], 1),
                 "final_loss": round(summary["final_loss"], 4),
@@ -545,7 +598,10 @@ def run_swarm(args):
                 "server_updates": server_update_total(),
                 "backward_rpcs_sent": sent,
                 "backward_rpcs_ok": acked,
-            }), flush=True)
+            }
+            if avg_session is not None:
+                summary_json["averaging"] = trainer.averaging_stats()
+            print(json.dumps(summary_json), flush=True)
         else:
             t0 = time.perf_counter()
             for step, (ids, tgt) in zip(
@@ -554,6 +610,16 @@ def run_swarm(args):
                 params, opt_state, loss = step_fn(
                     params, opt_state, jnp.asarray(ids), jnp.asarray(tgt)
                 )
+                if (
+                    avg_session is not None
+                    and (step + 1) % args.averaging_every == 0
+                    and step + 1 < args.steps  # the final round follows
+                ):
+                    # BLOCKING round between steps: all co-scheduled
+                    # sequential trainers rendezvous at the same step
+                    # index and leave with the group mean (or skip when
+                    # no group forms — a lone trainer keeps training)
+                    params = avg_session.blocking_round(params)
                 if (
                     ckpt is not None and args.checkpoint_every
                     and (step + 1) % args.checkpoint_every == 0
@@ -581,10 +647,34 @@ def run_swarm(args):
                         ),
                         flush=True,
                     )
+        if avg_session is not None:
+            # final blocking round: co-scheduled trainers rendezvous once
+            # more after their last step, so every participant ends with
+            # IDENTICAL trunk+gate parameters (the convergence contract
+            # tests/test_experiment_smoke.py asserts)
+            avg_session.wait_idle()
+            params = avg_session.blocking_round(
+                params, matchmaking_timeout=args.averaging_timeout * 2
+            )
+            print(json.dumps(
+                {"averaging": avg_session.averaging_stats()}
+            ), flush=True)
+            if args.checkpoint_dir:
+                os.makedirs(args.checkpoint_dir, exist_ok=True)
+                np.savez(
+                    os.path.join(args.checkpoint_dir,
+                                 "avg_final_params.npz"),
+                    **{
+                        f"p{i}": np.asarray(leaf)
+                        for i, leaf in enumerate(jax.tree.leaves(params))
+                    },
+                )
         if ckpt is not None:
             ckpt.save(args.steps, params, opt_state)
             print(f"# checkpointed trainer at step {args.steps}", flush=True)
     finally:
+        if avg_session is not None:
+            avg_session.shutdown()
         for server in servers:
             server.shutdown()
         for proc in procs:
@@ -666,6 +756,13 @@ def run_multi_trainer(args):
         ]
         if args.data:
             base += ["--data", args.data]
+        if args.averaging:
+            base += [
+                "--averaging",
+                "--averaging-every", str(args.averaging_every),
+                "--averaging-group-size", str(args.averaging_group_size),
+                "--averaging-timeout", str(args.averaging_timeout),
+            ]
         if args.wire_dtype:
             base += ["--wire-dtype", args.wire_dtype]
         if args.latency_weight:
@@ -721,12 +818,19 @@ def run_multi_trainer(args):
                     default=0,
                 )
 
+            avg_stats = [e["averaging"] for e in entries if "averaging" in e]
             per_trainer.append({
                 "trainer": t,
                 "first_loss": losses[0] if losses else None,
                 "final_loss": losses[-1] if losses else None,
                 "backward_rpcs_sent": last("backward_rpcs_sent"),
                 "backward_rpcs_ok": last("backward_rpcs_ok"),
+                "averaging_rounds": (
+                    avg_stats[-1]["rounds"] if avg_stats else None
+                ),
+                "averaging_degraded_rounds": (
+                    avg_stats[-1]["degraded_rounds"] if avg_stats else None
+                ),
             })
         sent_total = sum(t["backward_rpcs_sent"] for t in per_trainer)
         ok_total = sum(t["backward_rpcs_ok"] for t in per_trainer)

@@ -1,0 +1,104 @@
+"""Pytree ⇄ flat float32 vector, group partitions, and wire chunks.
+
+The all-reduce operates on ONE contiguous float32 vector per peer: the
+trainer's trunk+gate pytree is flattened leaf-by-leaf (jax flatten
+order), reduced, and restored with each leaf's original dtype.  Reducing
+in float32 regardless of storage dtype keeps the accumulation exact
+enough for the bitwise-parity contract (tests/test_averaging.py): every
+partition is summed ONCE, on one member, in sorted-peer order, so all
+members receive identical bytes.
+
+Partitioning is `np.array_split` semantics — member *i* of the sorted
+group owns partition *i* — and each partition is further cut into
+``chunk_elems``-sized wire chunks so one partition rides several
+rid-tagged mux frames instead of one huge payload (the client's
+MAX_FRAME_BYTES cap, and finer-grained timeout accounting).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+
+def flatten_tree(tree: Any) -> tuple[np.ndarray, Any, list]:
+    """Flatten a pytree to (float32 vector, treedef, leaf specs)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    specs = [(a.shape, a.dtype) for a in arrs]
+    if not arrs:
+        return np.zeros((0,), np.float32), treedef, specs
+    vec = np.concatenate(
+        [a.astype(np.float32, copy=False).ravel() for a in arrs]
+    )
+    return vec, treedef, specs
+
+
+def unflatten_tree(vec: np.ndarray, treedef: Any, specs: list) -> Any:
+    """Inverse of :func:`flatten_tree`; leaves come back as jax arrays in
+    their original shapes/dtypes."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, off = [], 0
+    for shape, dtype in specs:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        leaf = vec[off : off + n].reshape(shape).astype(dtype)
+        leaves.append(jnp.asarray(leaf))
+        off += n
+    if off != vec.size:
+        raise ValueError(
+            f"vector of {vec.size} elements does not match specs ({off})"
+        )
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def partition_bounds(n_elements: int, n_parts: int) -> list[tuple[int, int]]:
+    """[start, end) bounds of `np.array_split(range(n), n_parts)`."""
+    if n_parts <= 0:
+        raise ValueError("n_parts must be positive")
+    base, extra = divmod(n_elements, n_parts)
+    bounds, start = [], 0
+    for i in range(n_parts):
+        size = base + (1 if i < extra else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def chunk_ranges(length: int, chunk_elems: int) -> list[tuple[int, int]]:
+    """[offset, n) chunks covering a partition of ``length`` elements.
+    A zero-length partition still yields one empty chunk so the protocol
+    round-trips it (tiny trees with more members than elements)."""
+    if chunk_elems <= 0:
+        raise ValueError("chunk_elems must be positive")
+    if length == 0:
+        return [(0, 0)]
+    return [
+        (off, min(chunk_elems, length - off))
+        for off in range(0, length, chunk_elems)
+    ]
+
+
+def weighted_mean(
+    parts: Sequence[tuple[str, float, np.ndarray]]
+) -> np.ndarray:
+    """Weighted mean over ``(peer_id, weight, vector)`` contributions,
+    accumulated in sorted-peer order (float32 throughout) — the single
+    place reduction arithmetic happens, so every member of a group gets
+    bitwise-identical results for a partition and a re-weighted degraded
+    round is just this function over the survivors."""
+    if not parts:
+        raise ValueError("weighted_mean of no contributions")
+    ordered = sorted(parts, key=lambda p: p[0])
+    total_w = np.float32(0.0)
+    acc = None
+    for _, weight, vec in ordered:
+        w = np.float32(weight)
+        contrib = vec * w if weight != 1.0 else vec
+        acc = contrib.copy() if acc is None else acc + contrib
+        total_w = total_w + w
+    return (acc / total_w).astype(np.float32, copy=False)

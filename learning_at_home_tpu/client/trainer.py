@@ -23,6 +23,15 @@ async SGD).
 Convergence note: this is hogwild-style on the trunk; use the same LR you
 would for small async staleness.  ``n_workers=1`` reproduces the exact
 sequential semantics.
+
+Multi-trainer synchronization: in async-DP runs each trainer owns its own
+trunk/gate state.  :meth:`attach_averaging` plugs in an
+``averaging.AveragingSession`` — between local steps the session
+snapshots the params (consistent read under the apply lock), runs a
+DHT-matched group all-reduce with the other trainers in the background,
+and applies the group delta atomically (``params += mean - snapshot``;
+local steps taken during the round survive — delayed updates, the same
+staleness class as everything else here).
 """
 
 from __future__ import annotations
@@ -68,6 +77,7 @@ class PipelinedSwarmTrainer:
         self.losses: list[float] = []
         self.step_count = 0
         self.errors: list[BaseException] = []
+        self._averaging = None  # AveragingSession via attach_averaging
 
     # ---- internals ----
 
@@ -115,8 +125,32 @@ class PipelinedSwarmTrainer:
                 step_now = self.step_count
             if on_step is not None:
                 on_step(step_now, float(loss))
+            if self._averaging is not None:
+                self._averaging.notify_step(step_now)
 
     # ---- public API ----
+
+    def attach_averaging(self, session) -> None:
+        """Plug in an ``averaging.AveragingSession``: it snapshots params
+        between steps and applies the group mean atomically."""
+        session.attach_trainer(
+            snapshot_fn=lambda: self.snapshot()[0],
+            apply_fn=self.apply_param_transform,
+        )
+        self._averaging = session
+
+    def apply_param_transform(self, transform) -> None:
+        """Atomically replace ``params`` with ``transform(params)`` under
+        the apply lock (the averaging-apply entry point — never races an
+        optimizer update)."""
+        with self._apply_lock:
+            self.params = transform(self.params)
+
+    def averaging_stats(self) -> dict | None:
+        return (
+            self._averaging.averaging_stats()
+            if self._averaging is not None else None
+        )
 
     def snapshot(self) -> tuple:
         """A CONSISTENT (params, opt_state, step_count) triple — the three

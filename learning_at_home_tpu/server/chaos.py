@@ -31,6 +31,15 @@ class ChaosConfig:
     # of wire compression — client/moe.py ``wire_dtype``) are invisible
     # without this; ~12.5e6 (100 Mbit/s) models commodity WAN peers
     bandwidth_bps: float = 0.0
+    # averaging data plane (the ``avg_part`` replies of the trainer-side
+    # group all-reduce — averaging/handler.py): dropped frames exercise
+    # the sender's per-part timeout → degraded-round path, delays model a
+    # slow WAN peer without killing it.  Matchmaking control frames are
+    # never chaos'd (experiments measure reduction fault tolerance, not
+    # rendezvous flake).
+    averaging_drop_prob: float = 0.0
+    averaging_base_latency: float = 0.0
+    averaging_jitter: float = 0.0
     seed: Optional[int] = None
 
     def make(self) -> "ChaosInjector":
@@ -44,6 +53,8 @@ class ChaosInjector:
         self.injected_delays = 0
         self.injected_stragglers = 0
         self.injected_drops = 0
+        self.injected_averaging_drops = 0
+        self.injected_averaging_delays = 0
 
     async def before_reply(self, nbytes: int = 0) -> bool:
         """Apply chaos; returns False if the reply must be dropped.
@@ -64,5 +75,23 @@ class ChaosInjector:
         )
         if delay > 0:
             self.injected_delays += 1
+            await asyncio.sleep(delay)
+        return True
+
+    async def before_averaging_reply(self, nbytes: int = 0) -> bool:
+        """Chaos for averaging ``avg_part`` replies; returns False when
+        the reply must be dropped (the sender sees a part timeout)."""
+        c = self.config
+        if c.averaging_drop_prob and self.rng.random() < c.averaging_drop_prob:
+            self.injected_averaging_drops += 1
+            return False
+        delay = c.averaging_base_latency + (
+            self.rng.random() * c.averaging_jitter if c.averaging_jitter
+            else 0.0
+        )
+        if c.bandwidth_bps:
+            delay += nbytes / c.bandwidth_bps
+        if delay > 0:
+            self.injected_averaging_delays += 1
             await asyncio.sleep(delay)
         return True

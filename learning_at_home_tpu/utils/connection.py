@@ -143,12 +143,22 @@ class ConnectionPool:
         max_connections: int = 8,
         max_inflight: int = 64,
         negotiate_v2: bool = True,
+        require_v2: bool = False,
     ):
         self.endpoint = endpoint
         # v1 pin for protocols with their own message schema (the DHT's
         # handlers don't speak ``hello``; probing them would break the
         # connection instead of getting a clean error reply)
         self._negotiate_v2 = negotiate_v2
+        # v2 REQUIREMENT for protocols whose semantics depend on
+        # out-of-order replies (the averaging subsystem HOLDS avg_part
+        # replies until a partition reduces — on v1's one-RPC-per-socket
+        # discipline held replies starve the connection pool).  Such
+        # pools ignore the process-wide legacy/A-B v1 pin
+        # (``force_protocol_v1`` / LAH_CLIENT_PIPELINE=0), which exists
+        # to A/B the DISPATCH path, not to break averaging.
+        self._require_v2 = require_v2
+        self.max_inflight = max_inflight
         self._free: asyncio.Queue = asyncio.Queue()
         self._sem = asyncio.Semaphore(max_connections)
         # v2 state: protocol is negotiated ONCE per pool (None = never
@@ -247,7 +257,7 @@ class ConnectionPool:
         it, the one-RPC-per-socket v1 path otherwise (or when v1 is
         forced)."""
         with timeline.span(f"rpc.{msg_type}"):
-            if _v2_enabled() and self._negotiate_v2:
+            if (self._require_v2 or _v2_enabled()) and self._negotiate_v2:
                 if self._proto is None:
                     await self._negotiate(timeout)
                 if self._proto == 2:
@@ -329,6 +339,16 @@ class ConnectionPool:
             if rtype == "hello_ok" and "mux" in (rmeta.get("features") or []):
                 self._proto = 2
                 self._mux = _MuxConnection(reader, writer)
+            elif self._require_v2:
+                # a require_v2 pool must NEVER silently run v1 (held
+                # replies would starve the socket pool); leave the
+                # protocol unknown so a later retry — e.g. the right
+                # peer reclaiming a recycled port — can renegotiate
+                writer.close()
+                raise RemoteCallError(
+                    f"{self.endpoint}: peer does not speak protocol v2, "
+                    "which this pool requires"
+                )
             else:
                 self._proto = 1
                 self._free.put_nowait((reader, writer))
@@ -359,6 +379,15 @@ class ConnectionPool:
                     writer.close()
                 raise
             if rtype != "hello_ok" or "mux" not in (rmeta.get("features") or []):
+                if self._require_v2:
+                    # never demote a require_v2 pool (see _negotiate);
+                    # fail the exchange loudly instead
+                    writer.close()
+                    self._proto = None
+                    raise RemoteCallError(
+                        f"{self.endpoint}: peer stopped speaking protocol "
+                        "v2, which this pool requires"
+                    )
                 # the peer restarted as an older build: demote the pool
                 self._proto = 1
                 self._free.put_nowait((reader, writer))
@@ -430,11 +459,15 @@ class PoolRegistry:
         self,
         max_connections_per_endpoint: int = 8,
         negotiate_v2: bool = True,
+        require_v2: bool = False,
+        max_inflight: int = 64,
     ):
         self._pools: dict[Endpoint, ConnectionPool] = {}
         self._lock = threading.Lock()
         self.max_connections = max_connections_per_endpoint
         self.negotiate_v2 = negotiate_v2
+        self.require_v2 = require_v2
+        self.max_inflight = max_inflight
 
     def get(self, endpoint: Endpoint) -> ConnectionPool:
         endpoint = (endpoint[0], int(endpoint[1]))
@@ -445,7 +478,9 @@ class PoolRegistry:
                 if pool is None:
                     pool = ConnectionPool(
                         endpoint, self.max_connections,
+                        max_inflight=self.max_inflight,
                         negotiate_v2=self.negotiate_v2,
+                        require_v2=self.require_v2,
                     )
                     self._pools[endpoint] = pool
         return pool

@@ -32,6 +32,13 @@ wire-encode bytes the pack-once fan-out avoided), and per-RPC
 serialize-vs-wait breakdown also surfaces without profiling enabled via
 ``RemoteMixtureOfExperts.pack_times`` / ``wait_times`` and
 ``dispatch_stats()``.
+
+The trainer-side AVERAGING subsystem (ISSUE 3) records per-round
+``averaging.round`` spans and the counters ``averaging.rounds``,
+``averaging.degraded_rounds``, ``averaging.bytes_sent``; like the client
+dispatch path, its headline numbers (round p50/p99, group sizes,
+degraded fraction) also surface without profiling via
+``DecentralizedAverager.stats()`` / ``AveragingSession.averaging_stats()``.
 """
 
 from __future__ import annotations

@@ -86,7 +86,13 @@ def test_backward_reforward_uses_same_mask(dropout_server):
     params = server.experts["expert.0"].state_dict()["params"]
     module = DeterministicDropoutBlock(hidden_dim=HID)
     x = jnp.ones((2, HID), jnp.float32)
-    seed = jnp.asarray([3, 4], dtype=jnp.int32)
+    # seeds PINNED to a pair whose masks share a dropped unit: with
+    # rate 0.1 over 64 units, P(a given unit dropped in both rows) is
+    # 0.01 — the old (3, 4) pair happened to share none, so the
+    # both-dropped assertion below failed on pure seed luck, not on any
+    # contract violation.  (6, 10) shares 3 dropped units (verified),
+    # exercising the documented zero-gradient contract robustly.
+    seed = jnp.asarray([6, 10], dtype=jnp.int32)
 
     mask = jax.vmap(
         lambda s: jax.random.bernoulli(jax.random.PRNGKey(s), 0.9, (4 * HID,))

@@ -7,7 +7,10 @@ Runs ``pytest --collect-only`` on CPU and exits non-zero on any collection
 error, then a CLIENT-PATH SMOKE: one forward+backward RPC against a local
 server under BOTH wire protocols (legacy/v1 and pipelined/v2), so
 wire-format breakage fails here in seconds instead of ten minutes into
-the tier-1 run.  Wire it before the full suite:
+the tier-1 run, then an AVERAGING SMOKE: two in-process trainer-side
+averaging peers complete one DHT-matched all-reduce round and must end
+with identical parameters (``averaging_stats()["rounds"] == 1``).  Wire
+it before the full suite:
 
     python tools/collect_gate.py && pytest tests/ ...
 
@@ -62,6 +65,62 @@ def smoke_worker() -> int:
         )
     reset_client_rpc()
     print("SMOKE_OK protocols=v1,v2")
+    return averaging_smoke()
+
+
+def averaging_smoke() -> int:
+    """Two in-process averaging peers, one round: post-round parameter
+    equality and ``rounds == 1`` — the subsystem can't silently rot."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learning_at_home_tpu.averaging import (
+        AveragingConfig,
+        DecentralizedAverager,
+    )
+    from learning_at_home_tpu.dht import DHT
+
+    dht = DHT()
+    cfg = AveragingConfig(min_group_size=2, max_group_size=2,
+                          part_timeout=5.0)
+    a = DecentralizedAverager(dht, config=cfg, peer_id="gate-a")
+    b = DecentralizedAverager(dht, config=cfg, peer_id="gate-b")
+    trees = [
+        {"w": np.arange(33, dtype=np.float32) * (i + 1),
+         "b": np.full((5,), float(i), np.float32)}
+        for i in range(2)
+    ]
+    results: list = [None, None]
+
+    def run(i, av):
+        results[i] = av.step_round(trees[i], matchmaking_timeout=30.0)
+
+    try:
+        threads = [
+            threading.Thread(target=run, args=(i, av), daemon=True)
+            for i, av in enumerate((a, b))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "averaging round hung"
+        assert results[0] is not None and results[1] is not None
+        (tree_a, info_a), (tree_b, _) = results
+        assert not info_a["degraded"], info_a
+        for la, lb in zip(jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        want = (trees[0]["w"] + trees[1]["w"]) / np.float32(2.0)
+        np.testing.assert_allclose(np.asarray(tree_a["w"]), want, atol=0)
+        assert a.stats()["rounds"] == 1, a.stats()
+        assert b.stats()["rounds"] == 1, b.stats()
+    finally:
+        a.shutdown()
+        b.shutdown()
+        dht.shutdown()
+    print("AVG_SMOKE_OK rounds=1")
     return 0
 
 
@@ -77,8 +136,13 @@ def run_smoke() -> int:
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
         return 2
-    if r.returncode != 0 or "SMOKE_OK" not in r.stdout:
-        print("collect_gate: FAIL — client-path smoke:", file=sys.stderr)
+    if (
+        r.returncode != 0
+        or "SMOKE_OK" not in r.stdout
+        or "AVG_SMOKE_OK" not in r.stdout
+    ):
+        print("collect_gate: FAIL — client-path/averaging smoke:",
+              file=sys.stderr)
         print(r.stdout[-1000:], file=sys.stderr)
         print(r.stderr[-2000:], file=sys.stderr)
         return r.returncode or 1
