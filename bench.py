@@ -814,6 +814,32 @@ def dispatch_worker() -> None:
             "pack_bytes": st["pack_bytes"],
             "pack_p50_ms": st["pack_p50_ms"],
         }
+        # Stage-level timing for the BENCH_r*.json trajectory (ISSUE 4):
+        # a short PROFILED sample runs AFTER the A/B above — never during
+        # it (the A/B's contract is registry-always-on, tracing-off) —
+        # and its top spans + the always-on registry snapshot ride in the
+        # graded JSON, so trajectories carry pack/rpc/stack/dispatch/
+        # materialize breakdowns, not just end-to-end p50s.
+        from learning_at_home_tpu.utils.metrics import (
+            registry as metrics_registry,
+        )
+        from learning_at_home_tpu.utils.profiling import timeline
+
+        timeline.enable()
+        timeline.clear()
+        try:
+            measure(moe, rows, hid, n_dispatch=3, warmup=0)
+            span_summary = timeline.summary()
+        finally:
+            timeline.disable()
+            timeline.clear()
+        out["timeline_top_spans"] = dict(
+            sorted(
+                span_summary.items(), key=lambda kv: -kv[1]["total_ms"]
+            )[:10]
+        )
+        out["metrics_registry"] = metrics_registry.snapshot()
+
         # hot-path pipeline telemetry (ISSUE 1): the gain is measured,
         # not asserted — overlap fraction, off-loop stacking cost,
         # staging reuse and per-bucket compile/hit counts land in the

@@ -133,6 +133,19 @@ def parse_args():
     p.add_argument("--latency-weight", type=float, default=0.0,
                    help="swarm mode: debit expert selection scores by this "
                         "x endpoint RTT EMA (s) — route around slow peers")
+    p.add_argument("--telemetry-prefix", default="swarm",
+                   help="swarm mode: advertise this trainer's metrics "
+                        "endpoint under telemetry.<prefix> in the DHT "
+                        "(lah_top discovers it; utils/telemetry.py)")
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="swarm mode: don't host/advertise a metrics "
+                        "endpoint for this trainer")
+    p.add_argument("--telemetry-host", default="127.0.0.1",
+                   help="swarm mode: host the trainer's metrics endpoint "
+                        "binds AND advertises in the DHT — set to this "
+                        "machine's swarm-reachable address for "
+                        "cross-machine deployments (loopback is only "
+                        "correct for single-box swarms)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
                    help="trainer-side checkpoints (pod and swarm modes)")
@@ -493,6 +506,34 @@ def run_swarm(args):
         print(f"# averaging peer {averager.peer_id} on "
               f"{averager.endpoint[0]}:{averager.endpoint[1]}", flush=True)
 
+    telemetry = None
+    if not args.no_telemetry:
+        # the trainer is a swarm peer too: host a metrics endpoint and
+        # heartbeat it under telemetry.<prefix> so lah_top aggregates
+        # trainer dispatch/averaging stats next to the servers' (ISSUE 4)
+        from learning_at_home_tpu.utils.telemetry import TelemetryPublisher
+
+        def _trainer_extra():
+            extra = {
+                "dispatch": model.moes[0].dispatch_stats()
+                if model.moes else {},
+            }
+            if avg_session is not None:
+                extra["averaging"] = avg_session.averaging_stats()
+            return extra
+
+        try:
+            telemetry = TelemetryPublisher(
+                client_dht, prefix=args.telemetry_prefix, role="trainer",
+                host=args.telemetry_host, extra_fn=_trainer_extra,
+            ).start()
+            print(f"# trainer metrics endpoint http://{telemetry.endpoint[0]}:"
+                  f"{telemetry.port}/metrics (telemetry."
+                  f"{args.telemetry_prefix})", flush=True)
+        except Exception as e:  # telemetry must never kill training
+            print(f"# telemetry endpoint failed to start: {e}", flush=True)
+            telemetry = None
+
     # client-side recovery (§5.4): the trainer's trunk+gate params resume
     # from a checkpoint; expert params recover via the SERVER's per-expert
     # checkpoints (server --resume) — two halves of one contract
@@ -673,6 +714,8 @@ def run_swarm(args):
             ckpt.save(args.steps, params, opt_state)
             print(f"# checkpointed trainer at step {args.steps}", flush=True)
     finally:
+        if telemetry is not None:
+            telemetry.stop()
         if avg_session is not None:
             avg_session.shutdown()
         for server in servers:

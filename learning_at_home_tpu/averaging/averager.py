@@ -65,7 +65,7 @@ from learning_at_home_tpu.utils.connection import (
     PoolRegistry,
     RemoteCallError,
 )
-from learning_at_home_tpu.utils.profiling import timeline
+from learning_at_home_tpu.utils.profiling import new_trace_id, timeline
 from learning_at_home_tpu.utils.serialization import WireTensors
 
 logger = logging.getLogger(__name__)
@@ -332,6 +332,22 @@ class DecentralizedAverager:
         self._matchmaking_failures = 0
         # test hook: die silently after matchmaking (mid-round failure)
         self.debug_die_after_match = False
+        # always-on headline metrics (ISSUE 4): scrape-time collector on
+        # the process registry, weakref-pruned like the MoE's
+        import weakref
+
+        from learning_at_home_tpu.utils.metrics import (
+            registry as _metrics_registry,
+        )
+
+        ref = weakref.ref(self)
+
+        def _collect():
+            av = ref()
+            return None if av is None else av._headline_metrics()
+
+        self._collector_key = f"averager-{id(self)}"
+        _metrics_registry.register_collector(self._collector_key, _collect)
         try:
             self._server, self.port = self._loop.run(
                 self._start_server(host), timeout=10
@@ -358,6 +374,9 @@ class DecentralizedAverager:
         matchmaking budget; a mid-round member death never raises — the
         round completes degraded over the survivors."""
         t0 = time.monotonic()
+        # distributed tracing: stamp this round's span (minted only while
+        # profiling is on, same contract as the MoE dispatch trace)
+        trace = new_trace_id() if timeline.enabled else None
         group = self._matchmake(
             matchmaking_timeout
             if matchmaking_timeout is not None
@@ -404,15 +423,45 @@ class DecentralizedAverager:
             if info["degraded"]:
                 self._degraded_rounds += 1
             self._failed_parts += len(info["failed_parts"])
-        timeline.record("averaging.round", t0, dt)
+        timeline.record("averaging.round", t0, dt, trace=trace)
         timeline.count("averaging.rounds")
         if info["degraded"]:
             timeline.count("averaging.degraded_rounds")
         info.update(epoch=group.epoch, gid=group.gid, round_s=dt)
         return unflatten_tree(result_vec, treedef, specs), info
 
+    def _headline_metrics(self) -> dict:
+        """Always-on counters exported through the unified metrics
+        registry (utils/metrics.py) — also the backing data for
+        :meth:`stats`, so the two surfaces cannot drift apart."""
+        with self._stats_lock:
+            times = list(self._round_times)
+            out = {
+                "lah_averaging_rounds_total": self._rounds,
+                "lah_averaging_degraded_rounds_total": self._degraded_rounds,
+                "lah_averaging_failed_parts_total": self._failed_parts,
+                "lah_averaging_matchmaking_failures_total": (
+                    self._matchmaking_failures
+                ),
+                "lah_averaging_late_join_waits_total": self._late_join_waits,
+                "lah_averaging_joins_deferred_total": self._joins_deferred,
+            }
+        arr = np.asarray(times)
+        out["lah_averaging_round_p50_ms"] = (
+            round(float(np.percentile(arr, 50)) * 1e3, 3) if arr.size else 0.0
+        )
+        out["lah_averaging_bytes_sent_total"] = int(
+            sum(p.bytes_sent for p in self._registry.pools())
+        )
+        out["lah_averaging_bytes_received_total"] = int(
+            self.handler.bytes_received
+        )
+        return out
+
     def stats(self) -> dict:
-        """Counters for telemetry/bench JSON; msgpack-safe values only."""
+        """Counters for telemetry/bench JSON; msgpack-safe values only.
+        Plumbed through :meth:`_headline_metrics` (the registry's view)
+        plus the fields only this surface reports."""
 
         def pct(values, q):
             arr = np.asarray(values)
@@ -421,29 +470,42 @@ class DecentralizedAverager:
                 if arr.size else None
             )
 
+        m = self._headline_metrics()
         with self._stats_lock:
             times = list(self._round_times)
             sizes = list(self._group_sizes)
             out = {
                 "peer_id": self.peer_id,
                 "epoch": self._epoch,
-                "rounds": self._rounds,
-                "degraded_rounds": self._degraded_rounds,
-                "failed_parts": self._failed_parts,
-                "matchmaking_failures": self._matchmaking_failures,
-                "late_join_waits": self._late_join_waits,
-                "joins_deferred": self._joins_deferred,
+                "rounds": int(m["lah_averaging_rounds_total"]),
+                "degraded_rounds": int(
+                    m["lah_averaging_degraded_rounds_total"]
+                ),
+                "failed_parts": int(m["lah_averaging_failed_parts_total"]),
+                "matchmaking_failures": int(
+                    m["lah_averaging_matchmaking_failures_total"]
+                ),
+                "late_join_waits": int(
+                    m["lah_averaging_late_join_waits_total"]
+                ),
+                "joins_deferred": int(
+                    m["lah_averaging_joins_deferred_total"]
+                ),
             }
         out["group_size_last"] = sizes[-1] if sizes else None
         out["round_p50_ms"] = pct(times, 50)
         out["round_p99_ms"] = pct(times, 99)
-        out["bytes_sent"] = int(
-            sum(p.bytes_sent for p in self._registry.pools())
-        )
-        out["bytes_received"] = int(self.handler.bytes_received)
+        out["bytes_sent"] = int(m["lah_averaging_bytes_sent_total"])
+        out["bytes_received"] = int(m["lah_averaging_bytes_received_total"])
         return out
 
     def shutdown(self) -> None:
+        from learning_at_home_tpu.utils.metrics import (
+            registry as _metrics_registry,
+        )
+
+        _metrics_registry.unregister_collector(self._collector_key)
+
         async def _close():
             self._server.close()
             self._registry.close()

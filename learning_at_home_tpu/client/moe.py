@@ -55,7 +55,7 @@ from learning_at_home_tpu.utils.connection import (
     QUORUM_STRAGGLER_CANCEL,
     RemoteCallError,
 )
-from learning_at_home_tpu.utils.profiling import timeline
+from learning_at_home_tpu.utils.profiling import new_trace_id, timeline
 
 logger = logging.getLogger(__name__)
 
@@ -160,6 +160,7 @@ class RemoteMixtureOfExperts:
         # dispatch latency telemetry (north-star: dispatch p50); bounded so
         # long runs don't grow memory
         self.dispatch_times: deque[float] = deque(maxlen=10_000)
+        self.dispatches = 0  # cumulative (deques above are windows)
         # per-dispatch selected-uid sets (bounded like dispatch_times)
         self.selection_log: deque[frozenset] = deque(maxlen=10_000)
         # per-sample quorum telemetry: samples whose reply count fell below
@@ -186,6 +187,21 @@ class RemoteMixtureOfExperts:
         self.wait_times: deque[float] = deque(maxlen=10_000)
         self.pack_bytes = 0
         self.pack_bytes_saved = 0
+        # always-on headline metrics (ISSUE 4): expose this layer's
+        # counters through the process registry via a scrape-time
+        # collector — zero hot-path cost, pruned automatically once the
+        # MoE is garbage-collected (the weakref returns None)
+        import weakref
+
+        from learning_at_home_tpu.utils.metrics import registry as _registry
+
+        ref = weakref.ref(self)
+
+        def _collect():
+            moe = ref()
+            return None if moe is None else moe._headline_metrics()
+
+        _registry.register_collector(f"moe-{id(self)}", _collect)
 
     # ---- gate parameters ----
 
@@ -270,10 +286,21 @@ class RemoteMixtureOfExperts:
     # ---- host side: forward fan-out with k-of-n quorum ----
 
     def _host_forward(self, x, logits_concat, store_session: bool = True):
-        with timeline.span(f"moe.dispatch.{self.uid_prefix}"):
-            return self._host_forward_impl(x, logits_concat, store_session)
+        # distributed tracing: one compact trace id per dispatch, minted
+        # ONLY while profiling is enabled (the disabled path carries no
+        # extra meta and records nothing).  It rides in every RPC's meta,
+        # is stamped onto the client pack/rpc spans here and the server's
+        # stack/dispatch/materialize spans there, and the session carries
+        # it into backward — one forward+backward, one joinable trace.
+        trace = new_trace_id() if timeline.enabled else None
+        with timeline.span(f"moe.dispatch.{self.uid_prefix}", trace=trace):
+            return self._host_forward_impl(
+                x, logits_concat, store_session, trace
+            )
 
-    def _host_forward_impl(self, x, logits_concat, store_session: bool = True):
+    def _host_forward_impl(
+        self, x, logits_concat, store_session: bool = True, trace=None
+    ):
         import time as _time
 
         t0 = _time.monotonic()
@@ -350,6 +377,7 @@ class RemoteMixtureOfExperts:
                     for e, (rows, slots) in jobs.items()
                 },
                 x_full=x,
+                trace=trace,
             )
         else:
             uid_jobs = {
@@ -365,6 +393,7 @@ class RemoteMixtureOfExperts:
                 quorum=self.k_min,
                 rpc_timeout=self.forward_timeout,
                 prepared=prepared,
+                trace=trace,
             )
         )
         self.wait_times.append(_time.monotonic() - t_wait)
@@ -416,17 +445,20 @@ class RemoteMixtureOfExperts:
             cid = next(self._call_counter)
             with self._sessions_lock:
                 # the forward-dropped mask rides along so the backward path
-                # doesn't re-count those samples as backward failures
-                self._sessions[cid] = (session, dropped.copy())
+                # doesn't re-count those samples as backward failures; the
+                # trace id rides too — backward joins the forward's trace
+                self._sessions[cid] = (session, dropped.copy(), trace)
                 while len(self._sessions) > self.max_sessions:
                     self._sessions.popitem(last=False)
         self.dispatch_times.append(_time.monotonic() - t0)
+        self.dispatches += 1
         return y, idx, mask, np.int32(cid)
 
     # ---- host-thread serialization (the off-loop half of the pipeline) ----
 
     def _prepare_payloads(self, kind: str, uid_jobs: dict,
-                          x_full=None, gy_full=None) -> tuple[dict, dict]:
+                          x_full=None, gy_full=None,
+                          trace=None) -> tuple[dict, dict]:
         """Serialize the fan-out's payloads ON THIS host thread (the
         caller is already blocked inside io_callback) so the client event
         loop only writes ready buffers — the client-side mirror of PR 1's
@@ -486,25 +518,75 @@ class RemoteMixtureOfExperts:
         self.pack_times.append(dt)
         self.pack_bytes += nbytes
         self.pack_bytes_saved += saved
-        timeline.record(f"client.pack.{kind}", t0, dt)
+        timeline.record(f"client.pack.{kind}", t0, dt, trace=trace)
         timeline.count("client.pack.bytes", nbytes)
         timeline.count("client.pack_once.bytes_saved", saved)
         return out_jobs, prepared
 
+    def _headline_metrics(self) -> dict:
+        """The ~always-on headline counters this layer contributes to the
+        unified metrics registry (utils/metrics.py) — plain attribute
+        reads plus two scrape-time percentiles, never hot-path work.
+        ``dispatch_stats()`` and the Prometheus/JSON endpoints all read
+        THIS dict, so the numbers cannot drift apart."""
+
+        def snap(d):
+            # scrape threads race the training thread's appends; deque
+            # appends are atomic but ITERATION during one raises
+            # RuntimeError — retry rather than putting a lock on the
+            # per-dispatch hot path just for telemetry reads
+            for _ in range(4):
+                try:
+                    return list(d)
+                except RuntimeError:
+                    continue
+            return []
+
+        def p_ms(d, q):
+            arr = np.asarray(snap(d))
+            return (
+                round(float(np.percentile(arr, q)) * 1e3, 3)
+                if arr.size else 0.0
+            )
+
+        return {
+            "lah_client_dispatches_total": self.dispatches,
+            "lah_client_samples_total": self.samples_total,
+            "lah_client_samples_dropped_total": self.samples_dropped,
+            "lah_client_backward_samples_dropped_total": (
+                self.backward_samples_dropped
+            ),
+            "lah_client_backward_rpcs_sent_total": self.backward_rpcs_sent,
+            "lah_client_backward_rpcs_ok_total": self.backward_rpcs_ok,
+            "lah_client_pack_bytes_total": self.pack_bytes,
+            "lah_client_pack_once_bytes_saved_total": self.pack_bytes_saved,
+            "lah_client_dispatch_p50_ms": p_ms(self.dispatch_times, 50),
+            "lah_client_dispatch_p99_ms": p_ms(self.dispatch_times, 99),
+            "lah_client_pack_p50_ms": p_ms(self.pack_times, 50),
+            "lah_client_wait_p50_ms": p_ms(self.wait_times, 50),
+        }
+
     def dispatch_stats(self) -> dict:
         """Client hot-path counters for benchmarks/telemetry: serialize
         vs wait breakdown, bytes on the wire, pack-once savings, and the
-        per-pool multiplexed in-flight high-water mark."""
-        def p50_ms(d):
-            arr = np.asarray(d)
-            return round(float(np.percentile(arr, 50)) * 1e3, 3) if arr.size else None
+        per-pool multiplexed in-flight high-water mark.  Plumbed through
+        the same ``_headline_metrics`` dict the registry exports (ISSUE
+        4: no more hand-rolled parallel dicts) plus the process-wide
+        transport counters from the connection-pool registry."""
+        m = self._headline_metrics()
+
+        def nz(v):  # deques empty → None, the historical contract
+            return v if v else None
 
         pools = pool_registry().pools()
         return {
-            "pack_p50_ms": p50_ms(self.pack_times),
-            "wait_p50_ms": p50_ms(self.wait_times),
-            "pack_bytes": int(self.pack_bytes),
-            "pack_once_bytes_saved": int(self.pack_bytes_saved),
+            "pack_p50_ms": nz(m["lah_client_pack_p50_ms"]),
+            "wait_p50_ms": nz(m["lah_client_wait_p50_ms"]),
+            "pack_bytes": int(m["lah_client_pack_bytes_total"]),
+            "pack_once_bytes_saved": int(
+                m["lah_client_pack_once_bytes_saved_total"]
+            ),
+            "dispatches": int(m["lah_client_dispatches_total"]),
             "bytes_sent": int(sum(p.bytes_sent for p in pools)),
             "inflight_depth_max": max(
                 (p.inflight_max for p in pools), default=0
@@ -523,14 +605,18 @@ class RemoteMixtureOfExperts:
                 f"no dispatch session {int(cid)}: backward without forward, "
                 "or session evicted (raise max_sessions?)"
             )
-        session, fwd_dropped = entry
+        session, fwd_dropped, trace = entry
+        with timeline.span(f"moe.backward.{self.uid_prefix}", trace=trace):
+            return self._host_backward_impl(session, fwd_dropped, trace, gy)
+
+    def _host_backward_impl(self, session, fwd_dropped, trace, gy):
         batch = gy.shape[0]
         with self._sessions_lock:
             self.backward_rpcs_sent += len(session)
         prepared = None
         if dispatch_mode() == "pipelined":
             uid_jobs, prepared = self._prepare_payloads(
-                "backward", session, gy_full=gy
+                "backward", session, gy_full=gy, trace=trace
             )
         else:
             uid_jobs = {
@@ -548,6 +634,7 @@ class RemoteMixtureOfExperts:
                 quorum=self.backward_k_min,
                 rpc_timeout=self.backward_timeout,
                 prepared=prepared,
+                trace=trace,
             )
         )
         self.wait_times.append(_time.monotonic() - t_wait)
@@ -601,6 +688,7 @@ class RemoteMixtureOfExperts:
     async def _quorum_fanout(
         self, msg_type: str, jobs: dict, batch: int, quorum: int,
         rpc_timeout: float, prepared: Optional[dict] = None,
+        trace: Optional[str] = None,
     ) -> dict:
         """Run the fan-out in parallel; once every sample has ≥ quorum
         successful replies, wait a grace period then cancel stragglers (the
@@ -643,6 +731,11 @@ class RemoteMixtureOfExperts:
             )
             if self.wire_dtype is not None:
                 meta["wire"] = self.wire_dtype
+            if trace is not None:
+                # the trace id rides in the SAME meta on the merged call,
+                # the disaggregated retry, and the v1 fallback — the
+                # server stamps it onto its pool/runtime spans
+                meta["trace"] = trace
             pool = registry.get(endpoint)
             if prepared is not None:
                 tensors, _ = await pool.rpc_prepared(
@@ -674,6 +767,8 @@ class RemoteMixtureOfExperts:
             multi_meta = {"op": msg_type, "parts": parts}
             if self.wire_dtype is not None:
                 multi_meta["wire"] = self.wire_dtype
+            if trace is not None:
+                multi_meta["trace"] = trace
             pool = registry.get(endpoint)
             if prepared is not None:
                 from learning_at_home_tpu.utils.serialization import (

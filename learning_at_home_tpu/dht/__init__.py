@@ -132,6 +132,26 @@ class DHT:
     ) -> dict[str, Optional[Endpoint]]:
         return await self._bridge(self._get_experts(uids))
 
+    async def store(
+        self,
+        key,
+        value,
+        expiration_delta: float,
+        subkey: str = PLAIN_SUBKEY,
+    ) -> bool:
+        """Generic async store, callable from any loop — the telemetry
+        heartbeat (``telemetry.<prefix>`` records, utils/telemetry.py)
+        and other non-expert key families publish through this."""
+        return await self._bridge(
+            self.node.store(
+                key, value, get_dht_time() + expiration_delta, subkey
+            )
+        )
+
+    async def get(self, key) -> dict:
+        """Generic async get (fresh subkey records), loop-agnostic."""
+        return await self._bridge(self.node.get(key))
+
     @staticmethod
     def _parse_endpoint(value) -> Optional[Endpoint]:
         """Peer-supplied record value → (host, port), or None if malformed."""

@@ -59,6 +59,10 @@ def main() -> None:
                         "before serving (e.g. --warmup 64 256 1024); "
                         "no value = all power-of-2 buckets")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--telemetry-prefix", default="swarm",
+                   help="DHT scope the metrics endpoint is advertised "
+                        "under (telemetry.<prefix>); lah_top discovers "
+                        "all peers sharing a prefix")
     p.add_argument("--transport", default="asyncio",
                    choices=["asyncio", "native"],
                    help="data plane: asyncio loop, or the C++ epoll "
@@ -125,6 +129,7 @@ def main() -> None:
         dht=dht,
         update_period=args.update_period,
         transport=args.transport,
+        telemetry_prefix=args.telemetry_prefix,
         chaos=(
             ChaosConfig(
                 base_latency=args.chaos_latency,
@@ -151,7 +156,8 @@ def main() -> None:
     print(
         f"serving {len(experts)} {args.expert_cls!r} experts "
         f"({sorted(experts)[0]}..{sorted(experts)[-1]}) on "
-        f"{server.endpoint[0]}:{server.endpoint[1]}",
+        f"{server.endpoint[0]}:{server.endpoint[1]} "
+        f"(metrics http://{server.endpoint[0]}:{server.metrics_port}/metrics)",
         flush=True,
     )
 

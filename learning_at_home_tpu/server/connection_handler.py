@@ -46,6 +46,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
     WIRE_DTYPES,
     WireTensors,
@@ -192,7 +193,10 @@ class ConnectionHandler:
     # ---- per-op execution (validation + pool submit), shared by the
     #      single-expert and multi-expert paths; raises on any failure ----
 
-    async def _run_forward(self, uid: str, tensors, wire: str | None = None) -> list:
+    async def _run_forward(
+        self, uid: str, tensors, wire: str | None = None,
+        trace: str | None = None,
+    ) -> list:
         backend = self.server.experts.get(uid)
         if backend is None:
             raise ValueError(f"unknown expert uid: {uid!r}")
@@ -205,11 +209,14 @@ class ConnectionHandler:
                 f"got {len(tensors)}"
             )
         tensors = upcast_from_wire(tensors, wire)
-        result = await self.server.forward_pools[uid].submit_task(*tensors)
+        result = await self.server.forward_pools[uid].submit_task(
+            *tensors, trace=trace
+        )
         return downcast_to_wire(result, wire)
 
     async def _run_backward(
-        self, uid: str, tensors, declared_n_inputs, wire: str | None = None
+        self, uid: str, tensors, declared_n_inputs, wire: str | None = None,
+        trace: str | None = None,
     ) -> list:
         backend = self.server.experts.get(uid)
         if backend is None:
@@ -243,10 +250,12 @@ class ConnectionHandler:
                 f"(inputs + grad_outputs), got {len(tensors)}"
             )
         tensors = upcast_from_wire(tensors, wire)
-        result = await self.server.backward_pools[uid].submit_task(*tensors)
+        result = await self.server.backward_pools[uid].submit_task(
+            *tensors, trace=trace
+        )
         return downcast_to_wire(result, wire)
 
-    async def _run_multi(self, tensors, meta, rid=None) -> list:
+    async def _run_multi(self, tensors, meta, rid=None, trace=None) -> list:
         """Fan a merged request out to the local expert pools concurrently;
         per-part failures are reported per part, not as a whole-request
         error.  All meta is peer-supplied — validate structurally."""
@@ -273,9 +282,9 @@ class ConnectionHandler:
         async def run_part(part, part_tensors):
             uid = part.get("uid")
             if op == "forward":
-                return await self._run_forward(uid, part_tensors, wire)
+                return await self._run_forward(uid, part_tensors, wire, trace)
             return await self._run_backward(
-                uid, part_tensors, part.get("n_inputs"), wire
+                uid, part_tensors, part.get("n_inputs"), wire, trace
             )
 
         settled = await asyncio.gather(
@@ -297,15 +306,25 @@ class ConnectionHandler:
                     {"uid": uid, "ok": True, "n_tensors": len(result)}
                 )
                 reply_tensors.extend(result)
+        reply_meta = {"parts": reply_parts}
+        if trace is not None:
+            reply_meta["trace"] = trace  # echo: the reply joins the trace
         return pack_frames(
             "result", WireTensors.prepare(reply_tensors),
-            {"parts": reply_parts}, rid=rid,
+            reply_meta, rid=rid,
         )
 
-    def _server_stats(self) -> dict:
+    def _server_stats(self, include_spans: bool = False) -> dict:
         """Server-WIDE counters in one round trip (the ``info`` op is
         per-expert): ops dashboards and swarm telemetry poll this instead
-        of fanning out one RPC per hosted expert."""
+        of fanning out one RPC per hosted expert.
+
+        ``include_spans`` (request meta ``{"spans": true}``) adds the
+        Timeline span summaries.  Opt-in on purpose: summarizing a full
+        span deque on a PROFILED server is O(100k) work that would
+        otherwise run on this serving loop every time a monitor polls —
+        the dedicated-loop ``/metrics.json`` endpoint is the stall-free
+        default surface for span data."""
         srv = self.server
         experts = {}
         total_updates = 0
@@ -341,6 +360,8 @@ class ConnectionHandler:
                 "bucket_cold_compiles": cold,
                 "bucket_cache_hits": hits,
             }
+        from learning_at_home_tpu.utils.metrics import registry
+
         stats = {
             "n_experts": len(srv.experts),
             "update_count_total": total_updates,
@@ -349,7 +370,13 @@ class ConnectionHandler:
             # hot-path pipeline counters: queue depth, stacking/materialize
             # time, overlap fraction, staging-buffer reuse (ISSUE 1)
             "runtime": srv.runtime.stats(),
+            # ALWAYS-ON headline registry (ISSUE 4): the ~10 production
+            # counters are never empty just because LAH_PROFILE is off —
+            # this is the same snapshot the /metrics.json endpoint serves
+            "metrics": registry.snapshot(),
         }
+        if include_spans:
+            stats["spans"] = timeline.summary()
         if srv.chaos is not None:
             stats["chaos"] = {
                 "delays": srv.chaos.injected_delays,
@@ -362,9 +389,18 @@ class ConnectionHandler:
         """Serve one request; returns the reply as vectored frame parts
         (``pack_frames`` output — header buffer + raw tensor blobs), so
         the reply payload is never joined into one bytestring on this
-        loop.  ``rid`` (protocol v2) is echoed into the reply header."""
+        loop.  ``rid`` (protocol v2) is echoed into the reply header.
+
+        A ``{"trace": id}`` meta entry (distributed tracing) is
+        peer-supplied: it is structurally validated, stamped onto this
+        request's server-side spans and the downstream pool/runtime
+        spans, and ECHOED into the reply meta so the client can join the
+        round trip.  Absent trace → exactly the old behavior."""
+        trace = None
 
         def reply(msg_type: str, tensors=(), meta=None) -> list:
+            if trace is not None:
+                meta = {**(meta or {}), "trace": trace}
             return pack_frames(
                 msg_type, WireTensors.prepare(tensors), meta, rid=rid
             )
@@ -375,6 +411,9 @@ class ConnectionHandler:
             return reply("error", meta={"message": f"malformed request: {e}"})
         uid = meta.get("uid")
         wire = meta.get("wire")
+        trace = meta.get("trace")
+        if not (isinstance(trace, str) and 0 < len(trace) <= 64):
+            trace = None  # malformed/absent: never trust peer-supplied meta
         if wire is not None and wire not in WIRE_DTYPES:
             return reply(
                 "error",
@@ -382,30 +421,38 @@ class ConnectionHandler:
                       f"supported: {WIRE_DTYPES}"},
             )
         try:
-            if msg_type == "forward":
-                return reply(
-                    "result", await self._run_forward(uid, tensors, wire)
-                )
-            elif msg_type == "backward":
-                return reply(
-                    "result",
-                    await self._run_backward(
-                        uid, tensors, meta.get("n_inputs"), wire
-                    ),
-                )
-            elif msg_type == "multi":
-                return await self._run_multi(tensors, meta, rid)
-            elif msg_type == "info":
-                backend = self.server.experts.get(uid)
-                if backend is None:
-                    raise ValueError(f"unknown expert uid: {uid!r}")
-                return reply("result", meta=backend.get_info())
-            elif msg_type == "stats":
-                return reply("result", meta=self._server_stats())
-            else:
-                return reply(
-                    "error", meta={"message": f"unknown message type {msg_type!r}"}
-                )
+            with timeline.span(f"server.request.{msg_type}", trace=trace):
+                if msg_type == "forward":
+                    return reply(
+                        "result",
+                        await self._run_forward(uid, tensors, wire, trace),
+                    )
+                elif msg_type == "backward":
+                    return reply(
+                        "result",
+                        await self._run_backward(
+                            uid, tensors, meta.get("n_inputs"), wire, trace
+                        ),
+                    )
+                elif msg_type == "multi":
+                    return await self._run_multi(tensors, meta, rid, trace)
+                elif msg_type == "info":
+                    backend = self.server.experts.get(uid)
+                    if backend is None:
+                        raise ValueError(f"unknown expert uid: {uid!r}")
+                    return reply("result", meta=backend.get_info())
+                elif msg_type == "stats":
+                    return reply(
+                        "result",
+                        meta=self._server_stats(
+                            include_spans=bool(meta.get("spans"))
+                        ),
+                    )
+                else:
+                    return reply(
+                        "error",
+                        meta={"message": f"unknown message type {msg_type!r}"},
+                    )
         except Exception as e:
             logger.exception("request %s failed (expert %s)", msg_type, uid)
             return reply("error", meta={"message": f"{type(e).__name__}: {e}"})

@@ -46,6 +46,14 @@ logger = logging.getLogger(__name__)
 _SENTINEL = (float("-inf"), -1, None)
 
 
+def _job_trace(job: BatchJob) -> Optional[str]:
+    """The batch's trace id for span stamping: the single distinct
+    non-None task trace, or None when the batch merged several traced
+    requests (no single owner) or carried none."""
+    distinct = {t for t in getattr(job, "traces", ()) if t}
+    return distinct.pop() if len(distinct) == 1 else None
+
+
 @dataclass
 class _Inflight:
     """A dispatched-but-not-materialized job (the second pipeline stage)."""
@@ -55,6 +63,7 @@ class _Inflight:
     staging: list = field(default_factory=list)
     started: float = 0.0
     dispatch_s: float = 0.0  # duration of the process_fn call itself
+    trace: Optional[str] = None  # distributed-tracing id (see _job_trace)
 
 
 class Runtime:
@@ -146,13 +155,16 @@ class Runtime:
         started = time.monotonic()
         self.queue_time += started - job.formed_at
         buffers: list = []
+        trace = _job_trace(job)
         try:
-            with timeline.span(f"runtime.stack.{job.pool.name}"):
+            with timeline.span(f"runtime.stack.{job.pool.name}", trace=trace):
                 inputs, buffers = job.stack(self.staging)
             stacked = time.monotonic()
             self.stack_time += stacked - started
             job.pool.stack_time += stacked - started
-            with timeline.span(f"runtime.dispatch.{job.pool.name}"):
+            with timeline.span(
+                f"runtime.dispatch.{job.pool.name}", trace=trace
+            ):
                 raw = list(job.pool.process_fn(inputs))
             dispatched = time.monotonic()
         except BaseException as e:  # deliver, don't kill the device loop
@@ -161,7 +173,9 @@ class Runtime:
             self.jobs_processed += 1
             self._deliver(job, None, e)
             return None
-        return _Inflight(job, raw, buffers, started, dispatched - stacked)
+        return _Inflight(
+            job, raw, buffers, started, dispatched - stacked, trace
+        )
 
     def _finish(self, inflight: _Inflight) -> None:
         """Stage two: materialize the outputs (blocks until the device
@@ -171,7 +185,9 @@ class Runtime:
         outputs, error = None, None
         t0 = time.monotonic()
         try:
-            with timeline.span(f"runtime.materialize.{job.pool.name}"):
+            with timeline.span(
+                f"runtime.materialize.{job.pool.name}", trace=inflight.trace
+            ):
                 outputs = []
                 for o in inflight.raw_outputs:
                     arr = np.asarray(o)
@@ -199,7 +215,10 @@ class Runtime:
         busy = inflight.dispatch_s + (now - t0)
         self.device_time += busy
         self.jobs_processed += 1
-        timeline.record(f"runtime.{job.pool.name}", inflight.started, busy)
+        timeline.record(
+            f"runtime.{job.pool.name}", inflight.started, busy,
+            trace=inflight.trace,
+        )
         self.staging.release(inflight.staging)
         self._deliver(job, outputs, error)
 

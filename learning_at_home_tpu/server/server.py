@@ -48,6 +48,7 @@ class Server:
         batch_timeout: float = 0.002,
         chaos: Any = None,
         transport: str = "asyncio",
+        telemetry_prefix: str = "swarm",
     ):
         if transport not in ("asyncio", "native"):
             raise ValueError(f"transport must be 'asyncio' or 'native', got {transport!r}")
@@ -100,6 +101,68 @@ class Server:
         self._tcp_server: Optional[asyncio.base_events.Server] = None
         self._ready = threading.Event()
         self.port: Optional[int] = None
+        # observability (ISSUE 4): every server hosts a tiny metrics
+        # endpoint (Prometheus + JSON + chrome trace) on its own loop and
+        # advertises it under the telemetry.<prefix> DHT key — same
+        # TTL-as-failure-detector contract as expert heartbeats
+        self.telemetry_prefix = telemetry_prefix
+        self.metrics_server: Any = None
+        self.metrics_port: Optional[int] = None
+        self._metrics_loop: Optional[BackgroundLoop] = None
+        self._register_metrics_collector()
+
+    def _register_metrics_collector(self) -> None:
+        """Expose this server's always-on headline counters through the
+        process metrics registry — scrape-time attribute reads only, and
+        weakref-pruned once the server is garbage-collected."""
+        import weakref
+
+        from learning_at_home_tpu.utils.metrics import registry
+
+        ref = weakref.ref(self)
+
+        def _collect():
+            srv = ref()
+            return None if srv is None else srv._headline_metrics()
+
+        self._collector_key = f"server-{id(self)}"
+        registry.register_collector(self._collector_key, _collect)
+
+    def _headline_metrics(self) -> dict:
+        """The ~10 always-on production counters (ISSUE 4 satellite):
+        runtime pipeline, padding waste, staging reuse, bucket compiles,
+        expert updates — plain int/float reads, no locks, no spans."""
+        rt = self.runtime
+        staging = rt.staging.stats()
+        rows = padded = batches = cold = hits = 0
+        for pool_map in (self.forward_pools, self.backward_pools):
+            for p in pool_map.values():
+                rows += p.total_rows
+                padded += p.padded_rows
+                batches += p.batches_formed
+                bs = p.bucket_stats()
+                cold += bs["cold_compiles"]
+                hits += bs["cache_hits"]
+        return {
+            "lah_server_experts_total": len(self.experts),
+            "lah_server_updates_total": sum(
+                b.update_count for b in self.experts.values()
+            ),
+            "lah_server_jobs_processed_total": rt.jobs_processed,
+            "lah_server_jobs_overlapped_total": rt.jobs_overlapped,
+            "lah_server_queue_depth": rt.queue_depth,
+            "lah_server_queue_depth_max": rt.queue_depth_max,
+            "lah_server_stack_seconds_total": rt.stack_time,
+            "lah_server_materialize_seconds_total": rt.materialize_time,
+            "lah_server_device_seconds_total": rt.device_time,
+            "lah_server_staging_allocated_total": staging["allocated"],
+            "lah_server_staging_reused_total": staging["reused"],
+            "lah_server_rows_total": rows,
+            "lah_server_padded_rows_total": padded,
+            "lah_server_batches_formed_total": batches,
+            "lah_server_bucket_cold_compiles_total": cold,
+            "lah_server_bucket_cache_hits_total": hits,
+        }
 
     # ---- lifecycle ----
 
@@ -172,13 +235,38 @@ class Server:
 
     def run_in_background(self, await_ready: bool = True) -> "Server":
         assert self._loop is None, "server already started"
+        self._start_metrics_endpoint()
         self._loop = BackgroundLoop(name="lah-server")
         self.runtime.attach_loop(self._loop.loop)
         self.runtime.start()
         self._loop.run(self._start_async())
+        if self.metrics_server is not None:
+            # known only after the RPC socket binds; purely informational
+            self.metrics_server.meta["rpc_port"] = self.port
         if await_ready:
             self._ready.wait(timeout=30)
         return self
+
+    def _start_metrics_endpoint(self) -> None:
+        """Per-server observability endpoint (always on — an idle
+        endpoint costs one listening socket; scrapes do the work).  It
+        lives on its OWN loop thread: a /trace or /metrics.json scrape
+        can serialize megabytes of JSON, and that must never stall the
+        RPC serving loop a dispatch-latency investigation is probing."""
+        from learning_at_home_tpu.utils.metrics import MetricsHTTPServer
+
+        self.metrics_server = MetricsHTTPServer(
+            meta={"role": "server"}, extra_fn=self._telemetry_extra,
+        )
+        self._metrics_loop = BackgroundLoop(name="lah-metrics")
+        try:
+            self.metrics_port = self._metrics_loop.run(
+                self.metrics_server.start(self.host), timeout=10
+            )
+        except Exception:
+            logger.exception("metrics endpoint failed to start; serving blind")
+            self._metrics_loop.shutdown()
+            self.metrics_server = self.metrics_port = self._metrics_loop = None
 
     async def _start_async(self) -> None:
         handler = ConnectionHandler(self)
@@ -210,12 +298,25 @@ class Server:
                 self._declare_experts_forever(), name="dht-heartbeat"
             )
         logger.info(
-            "server listening on %s:%d with %d experts",
+            "server listening on %s:%d with %d experts (metrics on :%s)",
             self.host,
             self.port,
             len(self.experts),
+            self.metrics_port,
         )
         self._ready.set()
+
+    def _telemetry_extra(self) -> dict:
+        """Per-request payload merged into ``/metrics.json`` — the
+        expert-level detail lah_top renders that flat metrics can't carry
+        (per-expert update counts, runtime/pool breakdown)."""
+        return {
+            "experts": {
+                uid: b.update_count for uid, b in self.experts.items()
+            },
+            "runtime": self.runtime.stats(),
+            "endpoint": list(self.endpoint),
+        }
 
     def _native_worker(self, handler: ConnectionHandler) -> None:
         """THE single dispatcher thread: shovels whole frames from the
@@ -304,12 +405,26 @@ class Server:
                     del chains[cid]
 
     async def _declare_experts_forever(self) -> None:
-        """Liveness heartbeat: re-declare experts so DHT records stay fresh."""
+        """Liveness heartbeat: re-declare experts so DHT records stay
+        fresh, and advertise the metrics endpoint under the
+        ``telemetry.<prefix>`` key (utils/telemetry.py) with the same
+        TTL — one missed heartbeat cycle and the swarm view marks this
+        peer dead."""
+        from learning_at_home_tpu.utils.telemetry import telemetry_key
+
+        peer_id = f"server-{self.endpoint[0]}:{self.port}"
         while True:
             try:
                 await self.dht.declare_experts(
                     list(self.experts), self.endpoint, expiration=self.update_period * 2
                 )
+                if self.metrics_port is not None:
+                    await self.dht.store(
+                        telemetry_key(self.telemetry_prefix),
+                        [self.endpoint[0], self.metrics_port, "server"],
+                        expiration_delta=self.update_period * 2,
+                        subkey=peer_id,
+                    )
             except Exception:
                 logger.exception("declare_experts heartbeat failed")
             await asyncio.sleep(self.update_period)
@@ -355,11 +470,21 @@ class Server:
         return (host, self.port)
 
     def shutdown(self) -> None:
+        from learning_at_home_tpu.utils.metrics import registry
+
+        registry.unregister_collector(self._collector_key)
         if self._loop is None:
             return
         for pool in (*self.forward_pools.values(), *self.backward_pools.values()):
             with contextlib.suppress(Exception):
                 self._loop.loop.call_soon_threadsafe(pool.shutdown)
+        if self._metrics_loop is not None:
+            with contextlib.suppress(Exception):
+                self._metrics_loop.loop.call_soon_threadsafe(
+                    self.metrics_server.close
+                )
+            self._metrics_loop.shutdown()
+            self._metrics_loop = None
         if self._tcp_server is not None:
             self._loop.loop.call_soon_threadsafe(self._tcp_server.close)
         # native teardown ORDER matters (the pump's shutdown frees its C

@@ -67,6 +67,11 @@ class BatchJob:
     # the old np.concatenate path); None → take the first task's dtypes
     dtypes: Optional[list] = field(compare=False, default=None)
     formed_at: float = field(compare=False, default=0.0)
+    # distributed-tracing ids, one per task (None for untraced requests);
+    # the Runtime stamps its stage spans when the batch carries exactly
+    # one distinct trace — a merged multi-trainer batch has no single
+    # owner and stays unstamped
+    traces: list = field(compare=False, default_factory=list)
 
     def stack(self, staging) -> tuple[list, list]:
         """Copy task rows into padded staging buffers (Runtime thread).
@@ -104,6 +109,7 @@ class _Task:
     future: asyncio.Future
     arrived: float
     n_rows: int
+    trace: Optional[str] = None
 
 
 class TaskPool:
@@ -148,8 +154,12 @@ class TaskPool:
         self.warm_buckets = warm_buckets
         self.stack_time = 0.0  # accumulated by the Runtime (its thread)
 
-    async def submit_task(self, *tensors: np.ndarray) -> list[np.ndarray]:
-        """Submit one task (row-batch of tensors); await its outputs."""
+    async def submit_task(
+        self, *tensors: np.ndarray, trace: Optional[str] = None
+    ) -> list[np.ndarray]:
+        """Submit one task (row-batch of tensors); await its outputs.
+        ``trace`` (distributed tracing) rides along so the Runtime can
+        stamp this batch's stage spans with the originating request."""
         n_rows = int(tensors[0].shape[0])
         if n_rows > self.max_batch_size:
             raise ValueError(
@@ -157,7 +167,9 @@ class TaskPool:
                 f"{self.max_batch_size} for pool {self.name}"
             )
         future: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._tasks.put(_Task(tuple(tensors), future, time.monotonic(), n_rows))
+        await self._tasks.put(
+            _Task(tuple(tensors), future, time.monotonic(), n_rows, trace)
+        )
         return await future
 
     def start(self, runtime) -> None:
@@ -261,6 +273,7 @@ class TaskPool:
             target_rows=target,
             dtypes=dtypes,
             formed_at=time.monotonic(),
+            traces=[t.trace for t in batch],
         )
         runtime.submit(job)
 

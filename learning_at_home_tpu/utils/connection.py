@@ -255,8 +255,15 @@ class ConnectionPool:
 
         Routes to the multiplexed v2 path when the endpoint negotiated
         it, the one-RPC-per-socket v1 path otherwise (or when v1 is
-        forced)."""
-        with timeline.span(f"rpc.{msg_type}"):
+        forced).
+
+        A ``{"trace": id}`` entry in ``meta`` (distributed tracing,
+        docs/OBSERVABILITY.md) stamps this exchange's ``rpc.<msg_type>``
+        span with the request's trace id — the client-side anchor the
+        server's stack/dispatch/materialize spans nest inside."""
+        with timeline.span(
+            f"rpc.{msg_type}", trace=(meta or {}).get("trace")
+        ):
             if (self._require_v2 or _v2_enabled()) and self._negotiate_v2:
                 if self._proto is None:
                     await self._negotiate(timeout)

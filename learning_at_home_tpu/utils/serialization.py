@@ -21,6 +21,15 @@ so a received batch can be fed straight to ``jax.device_put`` in one hop.
 ``bfloat16`` (the TPU's native matmul dtype) is carried natively via
 ml_dtypes' numpy registration.  DHT metadata uses plain msgpack
 (``MSGPackSerializer`` parity).
+
+The header's ``m`` (meta) map is the extension point for cross-cutting
+request attributes: ``wire`` (transport compression), ``rid`` (protocol
+v2 multiplexing — a top-level header key, echoed in replies), and
+``trace`` (distributed tracing, ISSUE 4: a ≤64-char id the server stamps
+onto its profiling spans and echoes in the reply meta; see
+docs/OBSERVABILITY.md).  Meta travels inside the msgpack header on BOTH
+v1 and rid-tagged v2 frames, so trace propagation needs no framing
+change and absent keys cost zero bytes.
 """
 
 from __future__ import annotations

@@ -1,0 +1,342 @@
+"""The unified metrics registry (ISSUE 4): instruments, bounded label
+sets, collectors, Prometheus/JSON export, the Timeline counter-key cap,
+and the disabled-path overhead bound."""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from learning_at_home_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    sanitize_metric_name,
+)
+from learning_at_home_tpu.utils.profiling import Timeline, new_trace_id
+
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("lah_t_total", "things")
+    c.inc()
+    c.inc(2.5)
+    c.inc(1, pool="a")
+    assert c.value() == 3.5
+    assert c.value(pool="a") == 1.0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("lah_t_gauge")
+    g.set(7)
+    g.inc(3)
+    assert g.value() == 10.0
+
+    h = reg.histogram("lah_t_hist", buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    hs = snap["histograms"]["lah_t_hist"]
+    assert hs["count"] == 3 and hs["sum"] == 55.5
+    assert hs["buckets"]["1.0"] == 1 and hs["buckets"]["10.0"] == 2
+
+
+def test_kind_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("lah_x")
+    with pytest.raises(ValueError):
+        reg.gauge("lah_x")
+
+
+def test_name_sanitization():
+    assert sanitize_metric_name("runtime.stack.ffn.0") == "runtime_stack_ffn_0"
+    assert sanitize_metric_name("9lives") == "_9lives"
+    reg = MetricsRegistry()
+    c = reg.counter("a.b-c")
+    assert c.name == "a_b_c"
+
+
+# ---------------------------------------------------------------------------
+# bounded label sets — a long-lived peer must not leak cardinality
+# ---------------------------------------------------------------------------
+
+
+def test_label_sets_bounded_with_overflow_bucket():
+    reg = MetricsRegistry(max_label_sets=8)
+    c = reg.counter("lah_bounded_total")
+    for i in range(50):
+        c.inc(1, uid=f"expert.{i}")
+    with c._lock:
+        keys = set(c._values)
+    # 8 admitted + the single overflow series
+    assert len(keys) == 9
+    assert (("overflow", "true"),) in keys
+    # every observation was still counted somewhere
+    snap = reg.snapshot()
+    assert sum(snap["counters"]["lah_bounded_total"].values()) == 50
+    assert snap["dropped_label_sets"] == 42
+    text = reg.render_prometheus()
+    assert 'overflow="true"' in text
+    assert "lah_metrics_dropped_label_sets_total 42" in text
+
+
+# ---------------------------------------------------------------------------
+# collectors
+# ---------------------------------------------------------------------------
+
+
+def test_collectors_merge_rule_sum_totals_max_rest():
+    """_total names sum across collectors (event counts add); anything
+    else takes the MAX — summing two MoE layers' dispatch p50s would
+    report 2x the true latency (review finding, PR 4)."""
+    reg = MetricsRegistry()
+    reg.register_collector(
+        "layer0", lambda: {"lah_d_total": 2, "lah_d_p50_ms": 7.0}
+    )
+    reg.register_collector(
+        "layer1", lambda: {"lah_d_total": 3, "lah_d_p50_ms": 5.0}
+    )
+    merged = reg.collect()
+    assert merged["lah_d_total"] == 5.0
+    assert merged["lah_d_p50_ms"] == 7.0  # worst layer, never the sum
+
+
+def test_collectors_sum_and_prune():
+    reg = MetricsRegistry()
+    reg.register_collector("a", lambda: {"lah_widgets_total": 2})
+    reg.register_collector("b", lambda: {"lah_widgets_total": 3})
+    assert reg.collect()["lah_widgets_total"] == 5.0
+    # a collector returning None is pruned (the weakref-died idiom)
+    alive = {"flag": True}
+    reg.register_collector(
+        "c", lambda: {"lah_gone": 1} if alive["flag"] else None
+    )
+    assert reg.collect()["lah_gone"] == 1.0
+    alive["flag"] = False
+    assert "lah_gone" not in reg.collect()
+    with reg._lock:
+        assert "c" not in reg._collectors
+    # a CRASHING collector is skipped, never fatal
+    reg.register_collector("boom", lambda: 1 / 0)
+    assert reg.collect()["lah_widgets_total"] == 5.0
+
+
+def test_weakref_component_collector_prunes_after_gc():
+    import gc
+
+    reg = MetricsRegistry()
+
+    class Component:
+        def metrics(self):
+            return {"lah_component_up": 1}
+
+    import weakref
+
+    comp = Component()
+    ref = weakref.ref(comp)
+    reg.register_collector(
+        "comp", lambda: ref().metrics() if ref() else None
+    )
+    assert reg.collect()["lah_component_up"] == 1.0
+    del comp
+    gc.collect()
+    assert "lah_component_up" not in reg.collect()
+
+
+# ---------------------------------------------------------------------------
+# export formats
+# ---------------------------------------------------------------------------
+
+# one exposition line: metric name, optional {labels}, numeric value
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE+.\-]+(\.[0-9]+)?$"
+)
+
+
+def test_prometheus_text_parses():
+    reg = MetricsRegistry(max_label_sets=4)
+    reg.counter("lah_req_total", "requests served").inc(3, op="forward")
+    reg.gauge("lah_depth").set(2)
+    h = reg.histogram("lah_lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    reg.register_collector("x", lambda: {"lah_collected": 1.5})
+    text = reg.render_prometheus()
+    assert text.endswith("\n")
+    seen_types = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            seen_types[name] = kind
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    assert seen_types["lah_req_total"] == "counter"
+    assert seen_types["lah_lat_seconds"] == "histogram"
+    # histogram series: cumulative buckets + +Inf + sum/count
+    assert 'lah_lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lah_lat_seconds_count 2" in text
+    assert "lah_collected 1.5" in text
+
+
+def test_snapshot_is_json_and_msgpack_safe():
+    import msgpack
+
+    reg = MetricsRegistry()
+    reg.counter("lah_a").inc(1, uid="x.1")
+    reg.gauge("lah_b").set(0.5)
+    reg.histogram("lah_c").observe(0.2)
+    reg.register_collector("k", lambda: {"lah_d": 4})
+    snap = reg.snapshot()
+    json.dumps(snap)  # raises on anything non-serializable
+    msgpack.packb(snap, use_bin_type=True)  # the stats-RPC wire constraint
+
+
+# ---------------------------------------------------------------------------
+# Timeline counter-key cap (ISSUE 4 satellite: bounded key growth)
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_counter_keys_bounded():
+    tl = Timeline(max_counter_keys=8)
+    tl.enable()
+    for i in range(40):
+        tl.count(f"bucket.{i}", 2.0)
+    counters = tl.counters()
+    # 8 real keys + the two reserved accounting keys
+    assert len(counters) == 10
+    assert counters["timeline.dropped_keys"] == 32
+    assert counters["timeline.overflow"] == 64.0
+    # resident keys keep counting normally at the cap
+    tl.count("bucket.0", 1.0)
+    assert tl.counters()["bucket.0"] == 3.0
+    # reserved keys always work, even at the cap
+    tl.count("timeline.dropped_keys", 0.0)
+
+
+def test_timeline_cap_resets_on_clear():
+    tl = Timeline(max_counter_keys=4)
+    tl.enable()
+    for i in range(10):
+        tl.count(f"k.{i}")
+    tl.clear()
+    tl.count("fresh")
+    assert tl.counters() == {"fresh": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# trace ids + disabled-path overhead
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ids_compact_and_unique():
+    ids = {new_trace_id() for _ in range(256)}
+    assert len(ids) == 256
+    assert all(len(t) == 16 and int(t, 16) >= 0 for t in ids)
+
+
+def test_disabled_timeline_no_spans_no_counters_no_trace_cost():
+    tl = Timeline()
+    tl.disable()
+    with tl.span("x", trace="deadbeefdeadbeef"):
+        pass
+    tl.count("y")
+    assert tl.summary() == {} and tl.counters() == {}
+    assert tl.chrome_trace()[1:] == []  # only the process_name metadata
+
+
+def test_registry_disabled_path_overhead_bounded():
+    """Mirror of test_client_pipeline's no-work-on-loop regression, in
+    time form: with nothing scraping, the always-on surfaces cost plain
+    attribute arithmetic.  The bound is deliberately loose (sandbox CPUs
+    swing wildly) — it exists to catch an accidental O(n) or I/O on the
+    increment path, not to benchmark."""
+    reg = MetricsRegistry()
+    c = reg.counter("lah_hot_total")
+    n = 10_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c.inc()
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 100e-6, f"counter.inc costs {per_call * 1e6:.1f}µs"
+    # scrape-time work must not mutate instrument state
+    before = c.value()
+    reg.render_prometheus()
+    reg.snapshot()
+    assert c.value() == before
+
+
+def test_registry_scrape_never_runs_on_hot_thread():
+    """Collectors are scrape-time only: incrementing instruments must
+    not invoke any registered collector (the hot path would otherwise
+    pay arbitrary component-stats costs per dispatch)."""
+    reg = MetricsRegistry()
+    calls = []
+    reg.register_collector("probe", lambda: calls.append(1) or {})
+    c = reg.counter("lah_hot2_total")
+    for _ in range(100):
+        c.inc()
+    assert calls == []
+    reg.collect()
+    assert calls == [1]
+
+
+def test_concurrent_increments_are_consistent():
+    reg = MetricsRegistry()
+    c = reg.counter("lah_mt_total")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 4000
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export (unit level; end-to-end in test_observability)
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_event_shape(tmp_path):
+    tl = Timeline()
+    tl.enable()
+    t0 = time.monotonic()
+    tl.record("outer", t0, 0.010, trace="aa" * 8)
+    tl.record("inner", t0 + 0.002, 0.004, trace="aa" * 8)
+    tl.record("untraced", t0, 0.001)
+    events = tl.chrome_trace(process_name="unit")
+    meta = events[0]
+    assert meta["ph"] == "M" and meta["args"]["name"] == "unit"
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner", "untraced"}
+    for e in xs:
+        assert set(e) >= {"name", "ts", "dur", "pid", "tid", "cat"}
+    traced = {e["name"]: e for e in xs if "args" in e}
+    assert traced["outer"]["args"]["trace"] == "aa" * 8
+    assert "untraced" not in traced
+    # inner nests inside outer on the time axis
+    o, i = traced["outer"], traced["inner"]
+    assert o["ts"] <= i["ts"] and i["ts"] + i["dur"] <= o["ts"] + o["dur"]
+    # file export round-trips as JSON
+    path = tmp_path / "trace.json"
+    n = tl.save_chrome_trace(str(path), extra_events=[{"ph": "M", "pid": 9}])
+    doc = json.loads(path.read_text())
+    assert len(doc["traceEvents"]) == n == len(events) + 1

@@ -9,8 +9,11 @@ server under BOTH wire protocols (legacy/v1 and pipelined/v2), so
 wire-format breakage fails here in seconds instead of ten minutes into
 the tier-1 run, then an AVERAGING SMOKE: two in-process trainer-side
 averaging peers complete one DHT-matched all-reduce round and must end
-with identical parameters (``averaging_stats()["rounds"] == 1``).  Wire
-it before the full suite:
+with identical parameters (``averaging_stats()["rounds"] == 1``), then a
+TELEMETRY SMOKE (ISSUE 4): one DHT-joined server must expose the
+always-on headline metrics on its Prometheus endpoint and be rendered by
+``lah_top --once`` via DHT discovery alone.  Wire it before the full
+suite:
 
     python tools/collect_gate.py && pytest tests/ ...
 
@@ -65,7 +68,12 @@ def smoke_worker() -> int:
         )
     reset_client_rpc()
     print("SMOKE_OK protocols=v1,v2")
-    return averaging_smoke()
+    # sequence the remaining gates HERE so each smoke stays independently
+    # runnable and a failure is attributed to the right one
+    rc = averaging_smoke()
+    if rc:
+        return rc
+    return telemetry_smoke()
 
 
 def averaging_smoke() -> int:
@@ -124,6 +132,75 @@ def averaging_smoke() -> int:
     return 0
 
 
+def telemetry_smoke() -> int:
+    """Observability smoke (ISSUE 4): one server with a DHT, one driven
+    RPC; its Prometheus endpoint must carry the always-on headline
+    metrics WITHOUT LAH_PROFILE, and ``lah_top --once`` must discover
+    and render the peer via the DHT alone (no endpoint on the CLI)."""
+    import subprocess
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from learning_at_home_tpu.client import RemoteExpert, reset_client_rpc
+    from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.server.server import background_server
+    from learning_at_home_tpu.utils.telemetry import discover_telemetry
+
+    bootstrap = DHT()
+    dht = DHT(initial_peers=[bootstrap.endpoint])
+    try:
+        with background_server(
+            num_experts=1, hidden_dim=8, expert_prefix="tel", seed=0,
+            dht=dht, update_period=2.0,
+        ) as (endpoint, srv):
+            expert = RemoteExpert("tel.0", endpoint, timeout=30.0)
+            expert.forward_blocking([np.ones((2, 8), np.float32)])
+            assert srv.metrics_port, "server did not start a metrics endpoint"
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.metrics_port}/metrics", timeout=10
+            ).read().decode()
+            for needle in (
+                "lah_server_jobs_processed_total",
+                "lah_server_updates_total",
+                "lah_server_staging_reused_total",
+            ):
+                assert needle in text, f"headline metric {needle} missing"
+            # the telemetry.<prefix> record must appear via DHT discovery
+            deadline = time.time() + 30
+            peers = {}
+            while time.time() < deadline:
+                peers = discover_telemetry(bootstrap, "swarm")
+                if peers:
+                    break
+                time.sleep(0.5)
+            assert peers, "no telemetry.swarm record appeared in the DHT"
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tools", "lah_top.py"),
+                    "--once", "--prefix", "swarm", "--initial-peers",
+                    f"{bootstrap.endpoint[0]}:{bootstrap.endpoint[1]}",
+                ],
+                capture_output=True, text=True, timeout=60, cwd=REPO,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            assert r.returncode == 0, (
+                f"lah_top --once failed rc={r.returncode}:\n"
+                f"{r.stdout[-500:]}\n{r.stderr[-1000:]}"
+            )
+            assert "server-" in r.stdout and "tel.0" in r.stdout, (
+                f"lah_top did not render the discovered server:\n{r.stdout}"
+            )
+    finally:
+        reset_client_rpc()
+        dht.shutdown()
+        bootstrap.shutdown()
+    print("TELEMETRY_SMOKE_OK lah_top=dht-discovered")
+    return 0
+
+
 def run_smoke() -> int:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -131,7 +208,9 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            timeout=int(os.environ.get("COLLECT_GATE_TIMEOUT_S", "180")),
+            # three smokes now (client path, averaging, telemetry+lah_top
+            # subprocess): a wider default bound than the collect gate's
+            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "420")),
         )
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
@@ -140,8 +219,9 @@ def run_smoke() -> int:
         r.returncode != 0
         or "SMOKE_OK" not in r.stdout
         or "AVG_SMOKE_OK" not in r.stdout
+        or "TELEMETRY_SMOKE_OK" not in r.stdout
     ):
-        print("collect_gate: FAIL — client-path/averaging smoke:",
+        print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
               file=sys.stderr)
         print(r.stdout[-1000:], file=sys.stderr)
         print(r.stderr[-2000:], file=sys.stderr)

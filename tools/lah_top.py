@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""lah_top: a live, DHT-discovered swarm telemetry view (``top`` for the
+expert swarm).
+
+No metrics endpoint is ever passed on the CLI: the tool joins the DHT via
+``--initial-peers``, reads the ``telemetry.<prefix>`` key family (every
+server and trainer heartbeats its metrics endpoint there — record expiry
+IS the dead-peer detector), fetches each live peer's ``/metrics.json``,
+and renders one aggregated view:
+
+- per-peer rows: role, health, request throughput, queue depth, overlap
+  fraction, padding waste, degraded-averaging fraction;
+- an expert table merged across servers: per-expert async update counts;
+- dead peers: ids seen in an earlier refresh whose record expired, plus
+  peers whose record is live but whose endpoint stopped answering.
+
+Usage::
+
+    python tools/lah_top.py --initial-peers 10.0.0.1:31338            # live view
+    python tools/lah_top.py --initial-peers ... --once                # one frame
+    python tools/lah_top.py --initial-peers ... --json                # machine-readable
+    python tools/lah_top.py --initial-peers ... --once \
+        --dump-trace swarm_trace.json   # merge every peer's /trace into
+                                        # one chrome://tracing file
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def parse_endpoint(s: str) -> tuple[str, int]:
+    host, sep, port = s.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(f"--initial-peers entry {s!r} must be host:port")
+    return (host, int(port))
+
+
+def collect_snapshot(dht, prefix: str) -> list[dict]:
+    """One discovery + scrape pass: a row per advertised peer (rows for
+    unreachable peers carry ``snapshot=None``).  Scrapes run
+    CONCURRENTLY: during churn — exactly when this tool matters — several
+    advertised endpoints are dead-but-not-yet-expired, and serial 3 s
+    urlopen timeouts would stretch one frame to N×3 s."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from learning_at_home_tpu.utils.telemetry import (
+        discover_telemetry,
+        fetch_json,
+    )
+
+    peers = sorted(discover_telemetry(dht, prefix).items())
+    with ThreadPoolExecutor(max_workers=min(16, max(1, len(peers)))) as pool:
+        snapshots = list(
+            pool.map(lambda kv: fetch_json(kv[1]["endpoint"]), peers)
+        )
+    rows = []
+    for (peer_id, info), snap in zip(peers, snapshots):
+        rows.append(
+            {
+                "peer_id": peer_id,
+                "role": info["role"],
+                "endpoint": info["endpoint"],
+                "expires_at": info["expires_at"],
+                # peer-supplied: anything that isn't the expected dict
+                # shape counts as unreachable, never as a crash
+                "snapshot": snap if isinstance(snap, dict) else None,
+            }
+        )
+    return rows
+
+
+def _section(row: dict, key: str) -> dict:
+    """A dict-valued section of a peer snapshot; {} for anything
+    malformed (tolerate, never crash — the telemetry reader contract)."""
+    section = (row.get("snapshot") or {}).get(key)
+    return section if isinstance(section, dict) else {}
+
+
+def _collected(row: dict) -> dict:
+    collected = _section(row, "metrics").get("collected")
+    return collected if isinstance(collected, dict) else {}
+
+
+def _num(v, default=0.0) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def peer_health(row: dict) -> str:
+    """Coarse health verdict: ``ok`` / ``degraded`` / ``unreachable``.
+    Degraded = averaging rounds are failing over to survivor means, or
+    the runtime queue is visibly backed up."""
+    if row["snapshot"] is None:
+        return "unreachable"
+    m = _collected(row)
+    rounds = _num(m.get("lah_averaging_rounds_total"))
+    degraded = _num(m.get("lah_averaging_degraded_rounds_total"))
+    if rounds and degraded / rounds > 0.5:
+        return "degraded"
+    if _num(m.get("lah_server_queue_depth")) > 64:
+        return "degraded"
+    return "ok"
+
+
+def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
+    lines = [
+        f"lah_top — telemetry.{prefix} — {len(rows)} live peer(s), "
+        f"{len(dead)} dead — {time.strftime('%H:%M:%S')}",
+        "",
+        f"{'PEER':<28} {'ROLE':<8} {'HEALTH':<12} {'JOBS':>8} "
+        f"{'QDEPTH':>6} {'OVERLAP':>8} {'PADWASTE':>9} {'DISP':>8} "
+        f"{'AVG(dg/ok)':>11}",
+    ]
+    experts: dict[str, float] = {}
+    for row in rows:
+        m = _collected(row)
+        jobs = _num(m.get("lah_server_jobs_processed_total"))
+        overlapped = _num(m.get("lah_server_jobs_overlapped_total"))
+        rows_total = _num(m.get("lah_server_rows_total"))
+        padded = _num(m.get("lah_server_padded_rows_total"))
+        denom = rows_total + padded
+        rounds = _num(m.get("lah_averaging_rounds_total"))
+        degraded = _num(m.get("lah_averaging_degraded_rounds_total"))
+        lines.append(
+            f"{row['peer_id']:<28.28} {row['role']:<8.8} "
+            f"{peer_health(row):<12} {int(jobs):>8} "
+            f"{int(_num(m.get('lah_server_queue_depth'))):>6} "
+            f"{(overlapped / jobs if jobs else 0.0):>8.2f} "
+            f"{(padded / denom if denom else 0.0):>9.3f} "
+            f"{int(_num(m.get('lah_client_dispatches_total'))):>8} "
+            f"{int(degraded):>5}/{int(rounds):<5}"
+        )
+        for uid, n in _section(row, "experts").items():
+            experts[uid] = experts.get(uid, 0) + _num(n)
+    for peer_id in sorted(dead):
+        lines.append(f"{peer_id:<28.28} {'?':<8} {'DEAD':<12} (record expired)")
+    if experts:
+        lines.append("")
+        lines.append("EXPERTS (async update counts, merged across servers):")
+        for uid in sorted(experts):
+            lines.append(f"  {uid:<32} {int(experts[uid]):>10}")
+    # span-level latency only exists on peers running LAH_PROFILE=1
+    p99 = {}
+    for row in rows:
+        for name, s in _section(row, "spans").items():
+            if (
+                isinstance(s, dict)
+                and name.startswith("runtime.")
+                and name.count(".") == 1
+            ):
+                p99[f"{row['peer_id']}:{name}"] = _num(s.get("p99_ms"))
+    if p99:
+        lines.append("")
+        lines.append("RUNTIME p99 (profiled peers):")
+        for k in sorted(p99):
+            lines.append(f"  {k:<48} {p99[k]:>10.3f} ms")
+    return "\n".join(lines)
+
+
+def dump_trace(rows: list[dict], path: str) -> int:
+    """Merge every reachable peer's /trace events into one Chrome trace
+    file (each peer's events already carry its own pid).  Fetches run
+    concurrently, and only against peers the snapshot pass already
+    reached — dead endpoints don't burn a second round of timeouts."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from learning_at_home_tpu.utils.telemetry import fetch_trace_events
+
+    alive = [r for r in rows if r["snapshot"] is not None]
+    events: list = []
+    if alive:
+        with ThreadPoolExecutor(max_workers=min(16, len(alive))) as pool:
+            for chunk in pool.map(
+                lambda r: fetch_trace_events(r["endpoint"]), alive
+            ):
+                events.extend(chunk)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--prefix", default="swarm",
+                    help="telemetry.<prefix> DHT scope to watch")
+    ap.add_argument("--initial-peers", nargs="+", required=True,
+                    help="host:port of existing DHT peers (bootstrap only "
+                         "— metrics endpoints are DISCOVERED, never typed)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (0 iff ≥1 peer found)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw merged snapshot as JSON")
+    ap.add_argument("--dump-trace", default=None, metavar="PATH",
+                    help="also merge every peer's /trace into one Chrome "
+                         "trace_event file")
+    args = ap.parse_args(argv)
+
+    from learning_at_home_tpu.dht import DHT
+
+    dht = DHT(initial_peers=[parse_endpoint(s) for s in args.initial_peers])
+    seen: set[str] = set()
+    try:
+        while True:
+            rows = collect_snapshot(dht, args.prefix)
+            alive = {r["peer_id"] for r in rows}
+            dead = seen - alive
+            seen |= alive
+            if args.json:
+                print(json.dumps({
+                    "prefix": args.prefix,
+                    "peers": [
+                        {**r, "endpoint": list(r["endpoint"]),
+                         "health": peer_health(r)}
+                        for r in rows
+                    ],
+                    "dead": sorted(dead),
+                }), flush=True)
+            else:
+                if not args.once:
+                    print("\x1b[2J\x1b[H", end="")  # clear screen, go home
+                print(render(rows, args.prefix, dead), flush=True)
+            if args.dump_trace:
+                n = dump_trace(rows, args.dump_trace)
+                print(f"# wrote {n} trace events to {args.dump_trace}",
+                      flush=True)
+            if args.once:
+                return 0 if rows else 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        dht.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
