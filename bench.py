@@ -140,7 +140,11 @@ def run_worker(env: dict, deadline: int, label: str) -> tuple[dict | None, int]:
     return None, r.returncode
 
 
-def run_dispatch_microbench(deadline: int = 420) -> dict | None:
+def run_dispatch_microbench(deadline: int = 600) -> dict | None:
+    # 600 s: the worker now also runs the quantized-codec loopback A/B
+    # and the chaos WAN-proxy A/B (its own subprocess server) after the
+    # two classic regimes; each partial JSON is printed before the next
+    # stage so a late-stage timeout can never forfeit earlier numbers.
     """Swarm-tier dispatch p50 ([BJ] north-star metric #2) in a scrubbed
     CPU subprocess: the 64-row interactive regime AND the 2048-row
     production regime (f32 + bf16 wire) — see ``dispatch_worker``."""
@@ -177,7 +181,34 @@ def run_dispatch_microbench(deadline: int = 420) -> dict | None:
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "1ec472b"
+PREV_ROUND_REV = "25492de"
+
+
+def check_orphan_servers() -> dict | None:
+    """Refuse-or-flag guard against prior-session ``learning_at_home_tpu
+    .server`` orphans: they load the (single) core and corrupt every
+    absolute CPU number measured while they live — the round-4 churn
+    servers silently invalidated ~6 h of round-5 data (ROUND5_NOTES
+    hazards).  Returns a ``box_dirty`` dict to embed in the JSON (the
+    bench must always emit its line), or None on a clean box."""
+    try:
+        from learning_at_home_tpu.utils.subproc import find_orphan_servers
+
+        orphans = find_orphan_servers()
+    except Exception as e:
+        print(f"bench: orphan scan failed: {e}", file=sys.stderr)
+        return None
+    if not orphans:
+        return None
+    for pid, age, cmd in orphans:
+        print(f"bench: ORPHAN server pid={pid} age={age}s: {cmd}",
+              file=sys.stderr)
+    print("bench: box is DIRTY — timing numbers below are suspect; kill "
+          "the PIDs above and re-run", file=sys.stderr)
+    return {
+        "box_dirty": True,
+        "orphan_server_pids": [pid for pid, _age, _cmd in orphans],
+    }
 
 
 def run_prev_rev_compare(cur_tps: float, deadline: int = 420) -> dict | None:
@@ -247,6 +278,9 @@ def main() -> int:
     ambient = os.environ.get("JAX_PLATFORMS", "")
     result = None
     probe_err = ""
+    # BEFORE any timing work: detect prior-session orphan servers (the
+    # guard prints PIDs to stderr and stamps the JSON as box_dirty)
+    box_dirty = check_orphan_servers()
 
     if not force_cpu and ambient not in ("cpu",):
         platform, probe_err = probe_platform()
@@ -319,6 +353,8 @@ def main() -> int:
         avg = run_averaging_microbench()
         if avg:
             result.update(avg)
+    if box_dirty:
+        result.update(box_dirty)
     print(json.dumps(result), flush=True)
     return 0
 
@@ -932,20 +968,26 @@ def dispatch_worker() -> None:
         source = StaticExpertSource(
             {f"benchl.{i}": endpoint for i in range(n_experts_l)}
         )
-        def make_moe_l(wire):
+        def make_moe_l(wire, codec=None, src=None):
             # generous timeouts: on a loaded 1-core box the server's
             # first backward-bucket compiles can exceed the default 30 s,
             # and a timeout mid-compile cascades into cancelled quorums
             # instead of one slow warmup dispatch (excluded anyway)
             return RemoteMixtureOfExperts(
                 in_features=hid_l, grid_size=(n_experts_l,),
-                uid_prefix="benchl", source=source, k_best=2, k_min=2,
-                wire_dtype=wire, forward_timeout=90.0,
+                uid_prefix="benchl", source=src or source, k_best=2,
+                k_min=2, wire_dtype=wire, wire_codec=codec,
+                forward_timeout=90.0,
                 backward_timeout=90.0, timeout_after_k_min=30.0,
             )
 
         set_dispatch_mode("pipelined")
-        moe_l = make_moe_l(None)
+        # codec pinned "none": this is the HEADLINE f32-wire trajectory
+        # number (comparable back to round 2) — the adaptive default
+        # could legitimately escalate against the warmup-compile-skewed
+        # loopback bandwidth estimate, which would silently change the
+        # metric's meaning; the codec arms are measured separately below
+        moe_l = make_moe_l(None, codec="none")
         times = measure(moe_l, rows_l, hid_l, n_dispatch=10, warmup=3,
                         seed=2, forward_only=True)
         out["dispatch_p50_ms_large"] = p(times, 50)
@@ -978,6 +1020,51 @@ def dispatch_worker() -> None:
         )
         out["client_large_pack_p50_ms"] = st["pack_p50_ms"]
         out["dispatch_rows_large"] = rows_l
+
+        # Quantized-codec A/B (ISSUE 5), same interleaved-pairs
+        # methodology: none vs blockq8, pinned per arm, pipelined mode.
+        # The wire-bytes observable comes from the shared pool's
+        # sent+received counters, delta'd around each arm's dispatch.
+        set_dispatch_mode("pipelined")
+        from learning_at_home_tpu.client.rpc import pool_registry
+
+        moe_codec = {
+            c: make_moe_l(None, codec=c) for c in ("none", "blockq8")
+        }
+        for c, m in moe_codec.items():
+            measure(m, rows_l, hid_l, n_dispatch=2, warmup=2, seed=2,
+                    forward_only=True)  # warm both arms
+        codec_bytes = {c: 0 for c in moe_codec}
+        codec_n = {c: 0 for c in moe_codec}
+        pool_l = pool_registry().peek(endpoint)
+        for _ in range(5):
+            for c, m in moe_codec.items():
+                b0 = pool_l.bytes_sent + pool_l.bytes_received
+                measure(m, rows_l, hid_l, n_dispatch=1, warmup=0, seed=2,
+                        forward_only=True)
+                codec_bytes[c] += (
+                    pool_l.bytes_sent + pool_l.bytes_received - b0
+                )
+                codec_n[c] += 1
+        q8_t = np.asarray(moe_codec["blockq8"].dispatch_times)[2:]
+        none_t = np.asarray(moe_codec["none"].dispatch_times)[2:]
+        out["dispatch_p50_ms_large_blockq8"] = p(q8_t, 50)
+        out["dispatch_p50_ms_large_codec_none"] = p(none_t, 50)
+        out["dispatch_large_blockq8_vs_none"] = round(
+            p(q8_t, 50) / p(none_t, 50), 3
+        ) if p(none_t, 50) else None
+        out["wire_bytes_per_dispatch_none"] = (
+            codec_bytes["none"] // max(codec_n["none"], 1)
+        )
+        out["wire_bytes_per_dispatch_blockq8"] = (
+            codec_bytes["blockq8"] // max(codec_n["blockq8"], 1)
+        )
+        out["wire_reduction_blockq8"] = round(
+            codec_bytes["none"] / max(codec_bytes["blockq8"], 1), 2
+        )
+        out["codec_negotiated"] = dict(
+            moe_codec["blockq8"].dispatch_stats()["codecs"]
+        )
         set_dispatch_mode("pipelined")
     finally:
         proc.terminate()
@@ -990,8 +1077,127 @@ def dispatch_worker() -> None:
 
         reset_client_rpc()  # drop pooled connections + the client loop
 
+    # WAN-proxy chaos A/B (ISSUE 5 acceptance): against an emulated
+    # 25 MB/s link (server-side chaos bandwidth model), the codec must
+    # win on WALL CLOCK, not just bytes.  Loopback numbers above are
+    # printed first so a chaos-regime failure can never forfeit them.
+    print(json.dumps(out), flush=True)
+    if os.environ.get("BENCH_CODEC_CHAOS", "1") == "1":
+        try:
+            out.update(
+                _codec_chaos_ab(measure, make_moe_l_kwargs=dict(
+                    hid=hid_l, rows=rows_l, n_experts=n_experts_l,
+                ))
+            )
+        except Exception as e:
+            print(f"bench: codec chaos A/B failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            from learning_at_home_tpu.client import reset_client_rpc
+
+            reset_client_rpc()
+
     faulthandler.cancel_dump_traceback_later()
     print(json.dumps(out), flush=True)
+
+
+def _codec_chaos_ab(measure, make_moe_l_kwargs: dict) -> dict:
+    """Interleaved none-vs-blockq8 dispatch A/B against a subprocess
+    server whose chaos layer emulates a 25 MB/s WAN link (reply delayed
+    by (request+reply bytes)/bandwidth — server/chaos.py).  Payload
+    bytes dominate there, so the quantized arm must win wall-clock."""
+    import socket
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+
+    from learning_at_home_tpu.client import RemoteExpert
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.client.rpc import set_dispatch_mode
+    from learning_at_home_tpu.utils.connection import RemoteCallError
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    hid, rows, n_experts = (
+        make_moe_l_kwargs["hid"], make_moe_l_kwargs["rows"],
+        make_moe_l_kwargs["n_experts"],
+    )
+    bw = float(os.environ.get("BENCH_CHAOS_BW", str(25e6)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    wrapper = (
+        "import ctypes, os, sys; "
+        "ctypes.CDLL('libc.so.6').prctl(1, 9); "  # PDEATHSIG: no orphans
+        "os.execv(sys.executable, [sys.executable] + sys.argv[1:])"
+    )
+    proc = sp.Popen(
+        [
+            sys.executable, "-c", wrapper,
+            "-m", "learning_at_home_tpu.server",
+            "--expert-prefix", "benchw", "--num-experts", str(n_experts),
+            "--hidden-dim", str(hid), "--port", str(port), "--no-dht",
+            "--max-batch-size", "4096", "--warmup", "512", "1024",
+            "--chaos-bandwidth", str(bw),
+        ],
+        env=clean_jax_subprocess_env(REPO),
+        stdout=sp.DEVNULL, stderr=sp.STDOUT,
+    )
+    out: dict = {}
+    try:
+        endpoint = ("127.0.0.1", port)
+        probe = RemoteExpert("benchw.0", endpoint, timeout=20.0)
+        deadline = _time.time() + 90
+        while True:
+            try:
+                probe.forward_blocking([np.ones((2, hid), np.float32)])
+                break
+            except (OSError, RemoteCallError):
+                if proc.poll() is not None or _time.time() > deadline:
+                    raise RuntimeError("chaos server never came up")
+                _time.sleep(1.0)
+        source = StaticExpertSource(
+            {f"benchw.{i}": endpoint for i in range(n_experts)}
+        )
+        set_dispatch_mode("pipelined")
+        moes = {
+            c: RemoteMixtureOfExperts(
+                in_features=hid, grid_size=(n_experts,),
+                uid_prefix="benchw", source=source, k_best=2, k_min=2,
+                wire_codec=c, forward_timeout=120.0,
+                backward_timeout=120.0, timeout_after_k_min=60.0,
+            )
+            for c in ("none", "blockq8")
+        }
+        for m in moes.values():  # warm buckets + negotiation on both arms
+            measure(m, rows, hid, n_dispatch=1, warmup=1, seed=3,
+                    forward_only=True)
+        pairs = int(os.environ.get("BENCH_CHAOS_PAIRS", "3"))
+        for _ in range(pairs):
+            for m in moes.values():
+                measure(m, rows, hid, n_dispatch=1, warmup=0, seed=3,
+                        forward_only=True)
+        def p50(m):
+            t = np.asarray(m.dispatch_times)[1:]
+            return round(float(np.percentile(t, 50)) * 1e3, 2)
+
+        out["chaos_bandwidth_bps"] = bw
+        out["chaos_dispatch_p50_ms_none"] = p50(moes["none"])
+        out["chaos_dispatch_p50_ms_blockq8"] = p50(moes["blockq8"])
+        out["chaos_blockq8_vs_none"] = round(
+            out["chaos_dispatch_p50_ms_blockq8"]
+            / out["chaos_dispatch_p50_ms_none"], 3
+        ) if out["chaos_dispatch_p50_ms_none"] else None
+        out["chaos_ab_pairs"] = pairs
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except Exception:
+            proc.kill()
+            proc.wait(timeout=10)
+    return out
 
 
 def averaging_worker() -> None:

@@ -130,6 +130,12 @@ def parse_args():
                    help="swarm mode: downcast activation/grad RPC payloads "
                         "on the wire (servers still compute in f32) — "
                         "halves DCN bytes per dispatch")
+    p.add_argument("--wire-codec", default=None,
+                   choices=["none", "bf16", "f16", "u8", "blockq8"],
+                   help="swarm mode: pin the wire codec for dispatch "
+                        "payloads (8-bit codecs quarter DCN bytes vs f32; "
+                        "servers still compute in f32).  Default: adaptive "
+                        "per-pool escalation; LAH_WIRE_CODEC also works")
     p.add_argument("--latency-weight", type=float, default=0.0,
                    help="swarm mode: debit expert selection scores by this "
                         "x endpoint RTT EMA (s) — route around slow peers")
@@ -476,6 +482,7 @@ def run_swarm(args):
         grid_size=grid,
         k_best=args.k,
         wire_dtype=args.wire_dtype,
+        wire_codec=args.wire_codec,
         latency_weight=args.latency_weight,
     )
     model = SwarmDMoETransformerLM(cfg, client_dht)
@@ -808,6 +815,8 @@ def run_multi_trainer(args):
             ]
         if args.wire_dtype:
             base += ["--wire-dtype", args.wire_dtype]
+        if args.wire_codec:
+            base += ["--wire-codec", args.wire_codec]
         if args.latency_weight:
             base += ["--latency-weight", str(args.latency_weight)]
         if args.checkpoint_every:

@@ -107,6 +107,16 @@ class AveragingConfig:
     round_timeout: Optional[float] = None  # whole-reduction bound (derived)
     chunk_elems: int = 1 << 16  # elements per wire chunk (256 KiB of f32)
     orphan_ttl: float = 30.0  # GC for reductions never attached locally
+    # wire codec for OUTGOING partition chunks (ISSUE 5): None/"none" =
+    # raw f32 (today's wire); "bf16"/"u8"/"blockq8" encode each chunk
+    # off-loop before sending (4x fewer contribute-direction bytes at
+    # 8 bit).  The accumulator decodes to f32 before the sorted-peer
+    # reduction, and averaged REPLIES always travel raw f32 — one set of
+    # exact result bytes for everyone is what keeps members
+    # bitwise-equal per reduced partition.  Quantized chunks are only
+    # offered to owners whose hello echoed the ``codec`` feature (old
+    # builds transparently get raw f32).  LAH_AVG_WIRE_CODEC overrides.
+    wire_codec: Optional[str] = None
 
     def resolved_sender_timeout(self) -> float:
         return (
@@ -307,6 +317,16 @@ class DecentralizedAverager:
             raise ValueError("min_group_size must be >= 2 (averaging with "
                              "yourself is a no-op)")
         self.peer_id = peer_id or uuid.uuid4().hex[:12]
+        import os
+
+        from learning_at_home_tpu.utils.serialization import (
+            validate_wire_codec,
+        )
+
+        env_codec = os.environ.get("LAH_AVG_WIRE_CODEC") or None
+        validate_wire_codec(env_codec)
+        validate_wire_codec(self.cfg.wire_codec)
+        self._wire_codec = env_codec or self.cfg.wire_codec or "none"
         self.handler = AveragingPeerHandler(self, chaos=chaos)
         self._loop = BackgroundLoop(name="lah-avg")
         # require_v2: held avg_part replies NEED the out-of-order mux
@@ -387,8 +407,16 @@ class DecentralizedAverager:
             # parts and our partition, and gets neither
             return None, {"died_after_match": True, "gid": group.gid}
         vec, treedef, specs = flatten_tree(tree)
-        # pack-once, OFF the loop: every chunk's WireTensors is prepared
-        # here on the host thread; the loop only writes ready buffers
+        # pack-once, OFF the loop: every chunk's WireTensors — including
+        # any 8-bit quantize (cfg.wire_codec) — is prepared here on the
+        # host thread; the loop only writes ready buffers.  The raw f32
+        # slice view rides along so a peer that turns out not to speak
+        # the codec feature gets the uncompressed chunk instead (the
+        # fallback re-prepares specs only, never re-encodes bytes).
+        from learning_at_home_tpu.utils.serialization import (
+            encode_wire_tensors,
+        )
+
         bounds = partition_bounds(vec.size, len(group.members))
         sends = []
         for idx, (pid, mhost, mport, _w) in enumerate(group.members):
@@ -400,10 +428,15 @@ class DecentralizedAverager:
             chunk_elems = max(
                 self.cfg.chunk_elems, -((hi - lo) // -MAX_CHUNKS_PER_PART)
             )
-            chunks = [
-                (off, n, WireTensors.prepare([vec[lo + off : lo + off + n]]))
-                for off, n in chunk_ranges(hi - lo, chunk_elems)
-            ]
+            chunks = []
+            for off, n in chunk_ranges(hi - lo, chunk_elems):
+                raw = vec[lo + off : lo + off + n]
+                w_tensors, wmeta = encode_wire_tensors(
+                    [raw], self._wire_codec
+                )
+                chunks.append(
+                    (off, n, WireTensors.prepare(w_tensors), wmeta, raw)
+                )
             sends.append((idx, pid, (mhost, int(mport)), chunks))
         try:
             result_vec, info = self._run_on_loop(
@@ -456,6 +489,9 @@ class DecentralizedAverager:
         out["lah_averaging_bytes_received_total"] = int(
             self.handler.bytes_received
         )
+        out["lah_averaging_quantized_chunks_total"] = int(
+            self.handler.quantized_chunks
+        )
         return out
 
     def stats(self) -> dict:
@@ -497,6 +533,10 @@ class DecentralizedAverager:
         out["round_p99_ms"] = pct(times, 99)
         out["bytes_sent"] = int(m["lah_averaging_bytes_sent_total"])
         out["bytes_received"] = int(m["lah_averaging_bytes_received_total"])
+        out["wire_codec"] = self._wire_codec
+        out["quantized_chunks"] = int(
+            m["lah_averaging_quantized_chunks_total"]
+        )
         return out
 
     def shutdown(self) -> None:
@@ -801,19 +841,32 @@ class DecentralizedAverager:
         """Stream one partition's chunks to its owner and reassemble the
         averaged replies.  Any chunk failure fails the partition."""
         pool = self._registry.get(endpoint)
-        part_len = sum(n for _off, n, _w in chunks)
+        part_len = sum(n for _off, n, *_rest in chunks)
         out = np.empty(part_len, np.float32)
         sender_timeout = self.cfg.resolved_sender_timeout()
 
-        async def one(off: int, n: int, wire: WireTensors) -> None:
+        async def one(
+            off: int, n: int, wire: WireTensors, wmeta, raw
+        ) -> None:
+            meta = {
+                "gid": group.gid, "part": part_index,
+                "sender": self.peer_id, "w": float(self.cfg.weight),
+                "off": off, "part_len": part_len,
+            }
+            use_wire = wire
+            if wmeta is not None:
+                # encoded chunks are only OFFERED to owners that speak
+                # the codec feature; negotiate first (idempotent, locked)
+                # so the decision is made before the first byte moves.
+                # An old-build owner gets the raw f32 slice — a spec-walk
+                # re-prepare over the existing view, never a re-encode.
+                await pool.ensure_negotiated(sender_timeout)
+                if pool.supports("codec"):
+                    meta["wire"] = wmeta
+                else:
+                    use_wire = WireTensors.prepare([raw])
             tensors, _meta = await pool.rpc_prepared(
-                "avg_part", wire,
-                {
-                    "gid": group.gid, "part": part_index,
-                    "sender": self.peer_id, "w": float(self.cfg.weight),
-                    "off": off, "part_len": part_len,
-                },
-                timeout=sender_timeout,
+                "avg_part", use_wire, meta, timeout=sender_timeout,
             )
             chunk = as_f32_chunk(tensors)
             if len(chunk) != n:
@@ -823,8 +876,8 @@ class DecentralizedAverager:
             out[off : off + n] = chunk
 
         chunk_tasks = [
-            asyncio.get_running_loop().create_task(one(off, n, w))
-            for off, n, w in chunks
+            asyncio.get_running_loop().create_task(one(off, n, w, wm, raw))
+            for off, n, w, wm, raw in chunks
         ]
         try:
             await asyncio.gather(*chunk_tasks)

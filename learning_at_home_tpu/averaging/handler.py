@@ -20,6 +20,16 @@ Requests (docs/PROTOCOL.md "Averaging RPC family"):
                  [averaged chunk for the same [off, off+n) range].
                  The reply is held until the partition reduces (or the
                  accumulator times out and degrades to the survivors).
+                 The chunk may travel QUANTIZED (ISSUE 5): meta
+                 ``{"wire": ...}`` in either wire form declares the
+                 encoding; the accumulator decodes to f32 before the
+                 sorted-peer reduction.  Replies are ALWAYS raw f32 — the
+                 owner distributes one set of exact result bytes, which
+                 is what keeps every member bitwise-equal per reduced
+                 partition (a quantized reply would either break that or
+                 require group-wide codec consensus; the contribute
+                 direction is where N-1 senders stream concurrently, so
+                 that is where quantization pays).
 - ``avg_stats``: {} → ``result`` meta = averager.stats()
 - errors → ``error`` meta {message}
 
@@ -38,6 +48,7 @@ import numpy as np
 
 from learning_at_home_tpu.utils.serialization import (
     WireTensors,
+    decode_wire_tensors,
     frame_nbytes,
     pack_frames,
     peek_header,
@@ -52,8 +63,9 @@ if TYPE_CHECKING:
 
 logger = logging.getLogger(__name__)
 
-# Mirrors the expert server: ``mux`` is the only negotiated feature.
-AVERAGING_FEATURES = ("mux",)
+# Mirrors the expert server: ``mux`` (required — held replies) plus
+# ``codec`` (senders may quantize their partition chunks).
+AVERAGING_FEATURES = ("mux", "codec")
 
 
 class AveragingPeerHandler:
@@ -67,6 +79,7 @@ class AveragingPeerHandler:
         self.averager = averager
         self.chaos = chaos
         self.bytes_received = 0
+        self.quantized_chunks = 0  # avg_part requests that arrived 8-bit
 
     async def handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -167,6 +180,15 @@ class AveragingPeerHandler:
                     "result", meta=await self.averager._on_join(meta)
                 )
             elif msg_type == "avg_part":
+                wire = meta.get("wire")
+                if wire is not None:
+                    # decode BEFORE accumulation: the reduction is f32,
+                    # only the wire was quantized.  Chunks are small
+                    # (≤ chunk_elems), so the eager decode here costs
+                    # microseconds; validation raises → error reply.
+                    tensors = decode_wire_tensors(tensors, wire, lazy=False)
+                    if isinstance(wire, dict):
+                        self.quantized_chunks += 1
                 chunk = await self.averager._on_part(meta, tensors)
                 return msg_type, reply("result", [chunk])
             elif msg_type == "avg_stats":

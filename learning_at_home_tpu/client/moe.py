@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import threading
 from collections import OrderedDict, deque
 from typing import Optional, Sequence
@@ -49,6 +50,7 @@ from learning_at_home_tpu.client.routing import (
 from learning_at_home_tpu.client.rpc import (
     client_loop,
     dispatch_mode,
+    dispatch_wait_watchdog,
     pool_registry,
 )
 from learning_at_home_tpu.utils.connection import (
@@ -106,11 +108,15 @@ class RemoteMixtureOfExperts:
         beam_size: int = 8,
         merge_rpcs: bool = True,
         wire_dtype: Optional[str] = None,
+        wire_codec: Optional[str] = None,
         latency_weight: float = 0.0,
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
-        from learning_at_home_tpu.utils.serialization import validate_wire_dtype
+        from learning_at_home_tpu.utils.serialization import (
+            validate_wire_codec,
+            validate_wire_dtype,
+        )
 
         validate_wire_dtype(wire_dtype)
         from learning_at_home_tpu.client.rpc import ensure_sync_cpu_dispatch
@@ -138,6 +144,36 @@ class RemoteMixtureOfExperts:
         # large-row swarm dispatches that dominate dispatch p50; math
         # still runs f32 on both ends.  None = uncompressed f32.
         self.wire_dtype = wire_dtype
+        # wire CODEC (ISSUE 5): None = adaptive per-pool selection — the
+        # escalation policy in serialization.select_wire_codec picks
+        # none→bf16→8-bit from each pool's RTT EMA and measured bytes/sec
+        # (unmeasured/fast pools stay on the wire_dtype base, so the
+        # default wire is byte-identical to pre-codec builds).  An
+        # explicit codec ("none"/"bf16"/"f16"/"u8"/"blockq8") pins every
+        # pool; the LAH_WIRE_CODEC environment variable overrides both.
+        # Quantized codecs are only ever OFFERED to pools whose hello
+        # negotiation echoed the "codec" feature (v1 peers and old builds
+        # transparently fall back to the wire_dtype base), and only in
+        # pipelined dispatch mode (the legacy A/B arm keeps the exact
+        # pre-PR-2 wire).
+        env_codec = os.environ.get("LAH_WIRE_CODEC") or None
+        validate_wire_codec(env_codec)
+        validate_wire_codec(wire_codec)
+        self.wire_codec = env_codec or wire_codec
+        if self.wire_codec in ("bf16", "f16") and wire_dtype is not None:
+            from learning_at_home_tpu.utils.serialization import (
+                _DTYPE_TO_CODEC,
+            )
+
+            if _DTYPE_TO_CODEC.get(wire_dtype) != self.wire_codec:
+                raise ValueError(
+                    f"wire_codec={self.wire_codec!r} conflicts with "
+                    f"wire_dtype={wire_dtype!r}: a downcast codec pin must "
+                    "match the configured wire dtype (or drop one of them)"
+                )
+        # per-codec payload counts (plain int adds on the host thread;
+        # scrape readers copy-with-retry like the deques)
+        self.codec_counts: dict[str, int] = {}
         # latency-aware SELECTION (topology/load-aware routing, cf. the
         # TA-MoE / MoETuner line of work): each expert's selection score
         # is debited latency_weight × its endpoint's RTT EMA (seconds —
@@ -385,17 +421,21 @@ class RemoteMixtureOfExperts:
                 for e, (rows, slots) in jobs.items()
             }
         t_wait = _time.monotonic()
-        results = client_loop().run(
-            self._quorum_fanout(
-                msg_type="forward",
-                jobs=uid_jobs,
-                batch=batch,
-                quorum=self.k_min,
-                rpc_timeout=self.forward_timeout,
-                prepared=prepared,
-                trace=trace,
+        with dispatch_wait_watchdog(
+            self._slowest_rtt(uid_jobs),
+            what=f"forward dispatch ({self.uid_prefix}, {batch} rows)",
+        ):
+            results = client_loop().run(
+                self._quorum_fanout(
+                    msg_type="forward",
+                    jobs=uid_jobs,
+                    batch=batch,
+                    quorum=self.k_min,
+                    rpc_timeout=self.forward_timeout,
+                    prepared=prepared,
+                    trace=trace,
+                )
             )
-        )
         self.wait_times.append(_time.monotonic() - t_wait)
 
         y = np.zeros((batch, self.k_best, x.shape[1]), x.dtype)
@@ -454,7 +494,72 @@ class RemoteMixtureOfExperts:
         self.dispatches += 1
         return y, idx, mask, np.int32(cid)
 
+    @staticmethod
+    def _slowest_rtt(uid_jobs: dict):
+        """Worst involved pool's RTT EMA (the dispatch-wait watchdog's
+        scale); None when nothing has been measured yet."""
+        registry = pool_registry()
+        worst = None
+        for job in uid_jobs.values():
+            pool = registry.peek(job[0])
+            if pool is not None and pool.rtt_ema is not None:
+                worst = (
+                    pool.rtt_ema if worst is None
+                    else max(worst, pool.rtt_ema)
+                )
+        return worst
+
     # ---- host-thread serialization (the off-loop half of the pipeline) ----
+
+    def _base_codec(self) -> str:
+        from learning_at_home_tpu.utils.serialization import _DTYPE_TO_CODEC
+
+        return _DTYPE_TO_CODEC.get(self.wire_dtype, "none")
+
+    def _select_codec(self, kind: str, endpoint, nbytes: int) -> str:
+        """Per-pool wire codec for one fan-out request (docs/PROTOCOL.md
+        escalation policy).  Override (LAH_WIRE_CODEC / constructor) wins;
+        otherwise the adaptive selector escalates none→bf16→8-bit from
+        the pool's RTT EMA + measured bytes/sec.  Quantized codecs are
+        only offered to pools whose hello echoed the ``codec`` feature —
+        v1 peers, old builds and not-yet-negotiated pools fall back to
+        the wire_dtype base."""
+        from learning_at_home_tpu.utils.serialization import (
+            QUANTIZED_CODECS,
+            select_wire_codec,
+        )
+
+        base = self._base_codec()
+        pool = pool_registry().peek(endpoint)
+        if self.wire_codec is not None:
+            codec = self.wire_codec
+        else:
+            codec = select_wire_codec(
+                kind, nbytes,
+                pool.rtt_ema if pool is not None else None,
+                pool.bw_ema if pool is not None else None,
+                base=base,
+            )
+        if codec in QUANTIZED_CODECS and (
+            pool is None or not pool.supports("codec")
+        ):
+            return base
+        return codec
+
+    @staticmethod
+    def _wire_meta_for(codec: str, headers: list):
+        """meta ``{"wire": ...}`` value for one request's payload."""
+        from learning_at_home_tpu.utils.serialization import (
+            _CODEC_TO_DTYPE,
+            QUANTIZED_CODECS,
+        )
+
+        if codec in QUANTIZED_CODECS or any(
+            isinstance(h, dict) and h.get("c") in QUANTIZED_CODECS
+            for h in headers
+        ):
+            return {"c": codec, "h": headers}
+        return _CODEC_TO_DTYPE.get(codec)  # legacy string, or None for raw
 
     def _prepare_payloads(self, kind: str, uid_jobs: dict,
                           x_full=None, gy_full=None,
@@ -464,22 +569,36 @@ class RemoteMixtureOfExperts:
         loop only writes ready buffers — the client-side mirror of PR 1's
         no-work-on-the-loop rule.
 
-        Pack-once contract: the wire downcast runs once over the FULL
-        batch (``x`` forward, ``gy`` backward) and every expert's payload
-        is a slice of that one encoding; per-call packing would re-encode
-        each sample's rows once per selected expert (k× the work).  The
-        prepared blobs are immutable and shared across the merged
-        ``multi`` call and any disaggregated per-expert retry.  Backward
-        additionally reuses the forward's already-downcast rows stored in
-        the session — no re-encode at all for the input half.
+        Pack-once contract: the wire encode (downcast OR 8-bit quantize —
+        ISSUE 5) runs once over the FULL batch (``x`` forward, ``gy``
+        backward) per codec actually selected, and every expert's payload
+        — including its per-tensor quantization header — is a slice of
+        that one encoding (blockq8 blocks never cross the trailing axis,
+        so row gathers keep block alignment); per-call packing would
+        re-encode each sample's rows once per selected expert (k× the
+        work).  The prepared blobs are immutable and shared across the
+        merged ``multi`` call and any disaggregated per-expert retry.
+        Backward reuses the forward's already-encoded rows stored in the
+        session — identical bytes, so the server differentiates at
+        exactly the point it evaluated — and encodes only the gradients
+        (``blockq8`` when quantizing: gradient-safe per-block stats).
+
+        The codec is chosen PER POOL (one codec per endpoint per
+        direction, so a merged ``multi`` request stays one wire form);
+        swarms with heterogeneous link speeds may encode the batch under
+        more than one codec, each once.
 
         Returns ``(jobs, prepared)``: jobs with payload slots replaced by
-        the wire-encoded arrays (sessions then store wire rows), and
-        uid → :class:`WireTensors`.  ``pack_bytes_saved`` accumulates the
-        wire-encode bytes avoided vs per-call packing."""
+        the wire-encoded arrays (sessions then store wire rows — wrapped
+        with their headers for quantized codecs), and uid →
+        ``(WireTensors, wire_meta)``.  ``pack_bytes_saved`` accumulates
+        the wire-encode bytes avoided vs per-call packing."""
         import time as _time
 
         from learning_at_home_tpu.utils.serialization import (
+            EncodedBatch,
+            LazyDecode,
+            QUANTIZED_CODECS,
             WireTensors,
             is_float_dtype,
             wire_cast,
@@ -490,31 +609,130 @@ class RemoteMixtureOfExperts:
         out_jobs: dict = {}
         prepared: dict = {}
         saved = 0
+        itemsize = 4  # selection estimates assume f32 payloads
+
+        # one codec per endpoint per direction: estimate each pool's
+        # total payload and ask the selector once
+        ep_bytes: dict = {}
+        for uid, job in uid_jobs.items():
+            rows = job[2]
+            feat = (
+                int(np.prod(x_full.shape[1:])) if kind == "forward"
+                else int(gy_full.shape[-1]) * 2
+            )
+            ep_bytes[job[0]] = ep_bytes.get(job[0], 0) + len(rows) * feat * itemsize
+        ep_codec = {
+            ep: self._select_codec(kind, ep, nb) for ep, nb in ep_bytes.items()
+        }
+
+        enc_cache: dict = {}
+
+        def batch_enc(arr, codec, key) -> EncodedBatch:
+            eb = enc_cache.get((key, codec))
+            if eb is None:
+                eb = enc_cache[(key, codec)] = EncodedBatch.encode(arr, codec)
+            return eb
+
+        dup: dict = {}
         if kind == "forward":
-            x_wire = wire_cast([x_full], wd)[0]
-            dup = 0
             for uid, (ep, _x_rows, rows, slots) in uid_jobs.items():
-                rows_wire = x_wire[rows]
-                dup += rows_wire.nbytes
-                out_jobs[uid] = (ep, rows_wire, rows, slots)
-                prepared[uid] = WireTensors.prepare([rows_wire])
-            if wd is not None:
-                saved = max(0, dup - x_wire.nbytes)
+                codec = ep_codec[ep]
+                eb = batch_enc(x_full, codec, "x")
+                x_pay, h = eb.take(rows)
+                dup[codec] = dup.get(codec, 0) + x_pay.nbytes
+                # the session stores exactly the bytes the server saw, so
+                # backward can resend them verbatim
+                stored = (
+                    LazyDecode(x_pay, h)
+                    if isinstance(h, dict) and h.get("c") in QUANTIZED_CODECS
+                    else x_pay
+                )
+                out_jobs[uid] = (ep, stored, rows, slots)
+                prepared[uid] = (
+                    WireTensors.prepare([x_pay]),
+                    self._wire_meta_for(codec, [h]),
+                )
+                self.codec_counts[codec] = self.codec_counts.get(codec, 0) + 1
+                timeline.count(f"client.pack.codec.{codec}")
+                timeline.count(f"client.pack.codec.{codec}.bytes", x_pay.nbytes)
+            for codec, nbytes_dup in dup.items():
+                if codec != "none":
+                    saved += max(0, nbytes_dup - enc_cache[("x", codec)].wire.nbytes)
         else:
-            gy_wire = wire_cast([gy_full], wd)[0]
             for uid, (ep, x_stored, rows, slots) in uid_jobs.items():
-                x_pay = np.asarray(x_stored)
-                if wd is not None and is_float_dtype(x_pay.dtype):
-                    if x_pay.dtype == np.dtype(wd):
-                        # forward already encoded these rows: reuse them
-                        saved += x_pay.nbytes
-                    else:  # session from a legacy-mode forward
-                        x_pay = wire_cast([x_pay], wd)[0]
-                g_pay = gy_wire[rows, slots]
+                codec = ep_codec[ep]
+                eb = batch_enc(gy_full, codec, "gy")
+                g_pay, gh = eb.take((rows, slots))
+                # input half: resend the forward's exact wire bytes
+                if isinstance(x_stored, LazyDecode):
+                    pool = pool_registry().peek(ep)
+                    if pool is not None and pool.supports("codec"):
+                        x_pay, xh = x_stored.wire, x_stored.header
+                        saved += x_stored.wire_nbytes  # re-encode avoided
+                    else:  # peer demoted mid-session: decode locally
+                        x_pay, xh = np.asarray(x_stored, np.float32), None
+                        if codec in ("bf16", "f16"):
+                            from learning_at_home_tpu.utils.serialization import (  # noqa: E501
+                                _CODEC_TO_DTYPE,
+                            )
+
+                            # downcast request: all floats must match
+                            x_pay = wire_cast(
+                                [x_pay], _CODEC_TO_DTYPE[codec]
+                            )[0]
+                            xh = {"c": codec}
+                else:
+                    from learning_at_home_tpu.utils.serialization import (
+                        _CODEC_TO_DTYPE,
+                        _DTYPE_TO_CODEC,
+                    )
+
+                    x_pay = np.asarray(x_stored)
+                    xh = None
+                    if is_float_dtype(x_pay.dtype) and x_pay.dtype != np.dtype(
+                        np.float32
+                    ):
+                        # session rows already downcast by the forward
+                        name = _DTYPE_TO_CODEC.get(x_pay.dtype.name)
+                        if codec in ("bf16", "f16") and name == codec:
+                            saved += x_pay.nbytes  # reuse, same form
+                            xh = {"c": codec}
+                        elif name is not None and codec in QUANTIZED_CODECS:
+                            # quantized request: the dict form declares
+                            # the downcast per tensor — reuse the bytes
+                            saved += x_pay.nbytes
+                            xh = {"c": name}
+                        else:
+                            # form mismatch (adaptive drift between
+                            # directions, or a legacy-mode forward):
+                            # send exact f32 rather than violate the
+                            # all-floats-compressed legacy contract
+                            x_pay = np.asarray(x_pay, np.float32)
+                    elif (
+                        is_float_dtype(x_pay.dtype)
+                        and codec in ("bf16", "f16")
+                    ):
+                        # f32 session rows under a downcast request: the
+                        # legacy string form compresses ALL floats, x too
+                        x_pay = wire_cast(
+                            [x_pay], _CODEC_TO_DTYPE[codec]
+                        )[0]
+                        xh = {"c": codec}
+                wire_meta = self._wire_meta_for(codec, [xh, gh])
+                if not isinstance(wire_meta, dict):
+                    xh = None  # legacy string form: headers don't travel
                 out_jobs[uid] = (ep, x_pay, rows, slots, g_pay)
-                prepared[uid] = WireTensors.prepare([x_pay, g_pay])
+                prepared[uid] = (
+                    WireTensors.prepare([x_pay, g_pay]), wire_meta
+                )
+                self.codec_counts[codec] = self.codec_counts.get(codec, 0) + 1
+                timeline.count(f"client.pack.codec.{codec}")
+                timeline.count(
+                    f"client.pack.codec.{codec}.bytes",
+                    x_pay.nbytes + g_pay.nbytes,
+                )
         dt = _time.monotonic() - t0
-        nbytes = sum(p.nbytes for p in prepared.values())
+        nbytes = sum(p[0].nbytes for p in prepared.values())
         self.pack_times.append(dt)
         self.pack_bytes += nbytes
         self.pack_bytes_saved += saved
@@ -549,7 +767,12 @@ class RemoteMixtureOfExperts:
                 if arr.size else 0.0
             )
 
+        codec_counts = self._snap_codec_counts()
         return {
+            **{
+                f"lah_client_wire_codec_payloads_total_codec_{c}": n
+                for c, n in codec_counts.items()
+            },
             "lah_client_dispatches_total": self.dispatches,
             "lah_client_samples_total": self.samples_total,
             "lah_client_samples_dropped_total": self.samples_dropped,
@@ -588,11 +811,26 @@ class RemoteMixtureOfExperts:
             ),
             "dispatches": int(m["lah_client_dispatches_total"]),
             "bytes_sent": int(sum(p.bytes_sent for p in pools)),
+            "bytes_received": int(sum(p.bytes_received for p in pools)),
             "inflight_depth_max": max(
                 (p.inflight_max for p in pools), default=0
             ),
             "protocol": "v2" if any(p._proto == 2 for p in pools) else "v1",
+            # per-codec payload counts: which wire encoding dispatches
+            # actually negotiated+selected (the codec-smoke observable);
+            # copy-with-retry — a scrape racing the host thread's first
+            # insert of a new codec key must not crash on "dict changed
+            # size during iteration"
+            "codecs": self._snap_codec_counts(),
         }
+
+    def _snap_codec_counts(self) -> dict:
+        for _ in range(4):
+            try:
+                return dict(self.codec_counts)
+            except RuntimeError:
+                continue
+        return {}
 
     # ---- host side: backward fan-out to exactly the responders ----
 
@@ -626,17 +864,21 @@ class RemoteMixtureOfExperts:
         import time as _time
 
         t_wait = _time.monotonic()
-        results = client_loop().run(
-            self._quorum_fanout(
-                msg_type="backward",
-                jobs=uid_jobs,
-                batch=batch,
-                quorum=self.backward_k_min,
-                rpc_timeout=self.backward_timeout,
-                prepared=prepared,
-                trace=trace,
+        with dispatch_wait_watchdog(
+            self._slowest_rtt(uid_jobs),
+            what=f"backward dispatch ({self.uid_prefix}, {batch} rows)",
+        ):
+            results = client_loop().run(
+                self._quorum_fanout(
+                    msg_type="backward",
+                    jobs=uid_jobs,
+                    batch=batch,
+                    quorum=self.backward_k_min,
+                    rpc_timeout=self.backward_timeout,
+                    prepared=prepared,
+                    trace=trace,
+                )
             )
-        )
         self.wait_times.append(_time.monotonic() - t_wait)
         gx = np.zeros((batch, gy.shape[-1]), gy.dtype)
         ok = np.zeros(batch, np.int64)
@@ -729,8 +971,6 @@ class RemoteMixtureOfExperts:
                 if msg_type == "forward"
                 else {"uid": uid, "n_inputs": 1}
             )
-            if self.wire_dtype is not None:
-                meta["wire"] = self.wire_dtype
             if trace is not None:
                 # the trace id rides in the SAME meta on the merged call,
                 # the disaggregated retry, and the v1 fallback — the
@@ -738,10 +978,15 @@ class RemoteMixtureOfExperts:
                 meta["trace"] = trace
             pool = registry.get(endpoint)
             if prepared is not None:
+                wire_obj, wmeta = prepared[uid]
+                if wmeta is not None:
+                    meta["wire"] = wmeta
                 tensors, _ = await pool.rpc_prepared(
-                    msg_type, prepared[uid], meta, timeout=rpc_timeout
+                    msg_type, wire_obj, meta, timeout=rpc_timeout
                 )
             else:
+                if self.wire_dtype is not None:
+                    meta["wire"] = self.wire_dtype
                 job = jobs[uid]
                 payload = (
                     [cast(job[1])]
@@ -765,8 +1010,6 @@ class RemoteMixtureOfExperts:
                     part["n_inputs"] = 1
                 parts.append(part)
             multi_meta = {"op": msg_type, "parts": parts}
-            if self.wire_dtype is not None:
-                multi_meta["wire"] = self.wire_dtype
             if trace is not None:
                 multi_meta["trace"] = trace
             pool = registry.get(endpoint)
@@ -776,12 +1019,29 @@ class RemoteMixtureOfExperts:
                 )
 
                 # spec/blob reference concat — the per-uid buffers packed
-                # once on the host thread serve the merged request as-is
-                wire = WireTensors.concat([prepared[uid] for uid in uids])
+                # once on the host thread serve the merged request as-is.
+                # One codec per endpoint (prepared enforces it), so the
+                # merged wire meta is the first uid's form with the
+                # per-tensor headers concatenated in parts order.
+                wire = WireTensors.concat(
+                    [prepared[uid][0] for uid in uids]
+                )
+                wmeta = prepared[uids[0]][1]
+                if isinstance(wmeta, dict):
+                    wmeta = {
+                        "c": wmeta["c"],
+                        "h": [
+                            h for uid in uids for h in prepared[uid][1]["h"]
+                        ],
+                    }
+                if wmeta is not None:
+                    multi_meta["wire"] = wmeta
                 reply_tensors, reply_meta = await pool.rpc_prepared(
                     "multi", wire, multi_meta, timeout=rpc_timeout
                 )
             else:
+                if self.wire_dtype is not None:
+                    multi_meta["wire"] = self.wire_dtype
                 payload = []
                 for uid in uids:
                     job = jobs[uid]

@@ -7,12 +7,16 @@ the TPU-build replacement for the reference's thread-per-call dispatch.
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import os
 import threading
 from typing import Optional
 
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
 from learning_at_home_tpu.utils.connection import PoolRegistry, force_protocol_v1
+
+logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _loop: Optional[BackgroundLoop] = None
@@ -88,6 +92,87 @@ def ensure_sync_cpu_dispatch() -> None:
             "dispatch docstring", type(e).__name__, e,
         )
         _sync_dispatch_set = True
+
+
+# --------------------------------------------------------------------------
+# dispatch-wait watchdog (ISSUE 5 satellite): the jitted-client
+# io_callback deadlock class (ROUND5_NOTES "hazards") presents as a
+# SILENT hang — the host thread blocks in client_loop().run() forever
+# while the loop waits on buffers the blocked thread will never release.
+# A watchdog timer armed around the dispatch wait turns that into a
+# diagnosable event: one WARNING per process, with every thread's stack.
+# --------------------------------------------------------------------------
+
+_watchdog_lock = threading.Lock()
+_watchdog_fired = False
+
+
+def reset_dispatch_watchdog() -> None:
+    """Re-arm the once-per-process watchdog warning (test hook)."""
+    global _watchdog_fired
+    with _watchdog_lock:
+        _watchdog_fired = False
+
+
+def _all_thread_stacks() -> str:
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+def _watchdog_fire(budget: float, what: str) -> None:
+    global _watchdog_fired
+    with _watchdog_lock:
+        if _watchdog_fired:
+            return
+        _watchdog_fired = True
+    logger.warning(
+        "dispatch-wait watchdog: %s has waited > %.2fs (watchdog budget = "
+        "LAH_DISPATCH_WATCHDOG_MULT x pool RTT-EMA).  If this never "
+        "completes, suspect the jitted-client io_callback deadlock "
+        "(ROUND5_NOTES hazards).  Thread stacks:\n%s",
+        what, budget, _all_thread_stacks(),
+    )
+
+
+@contextlib.contextmanager
+def dispatch_wait_watchdog(rtt_ema: Optional[float], what: str = "dispatch"):
+    """Arm a timer for the enclosed blocking dispatch wait.
+
+    Budget = ``LAH_DISPATCH_WATCHDOG_MULT`` (default 20) x the slowest
+    involved pool's RTT EMA, floored at ``LAH_DISPATCH_WATCHDOG_MIN_S``
+    (default 5 s — cold pools' first exchanges legitimately include
+    connects and server-side warmup compiles).  Disabled when the
+    multiple is <= 0 or no RTT has ever been measured (nothing to scale
+    from).  Firing logs ONE warning per process with all thread stacks
+    and never interrupts the wait — diagnosis, not intervention."""
+    if _watchdog_fired or rtt_ema is None:
+        # once the single warning is out there is nothing left to arm —
+        # don't pay a Timer-thread create/cancel per dispatch forever
+        yield
+        return
+    try:
+        mult = float(os.environ.get("LAH_DISPATCH_WATCHDOG_MULT", "20"))
+        floor = float(os.environ.get("LAH_DISPATCH_WATCHDOG_MIN_S", "5"))
+    except ValueError:
+        mult, floor = 20.0, 5.0
+    if mult <= 0:
+        yield
+        return
+    budget = max(mult * rtt_ema, floor)
+    timer = threading.Timer(budget, _watchdog_fire, args=(budget, what))
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
 
 
 def client_loop() -> BackgroundLoop:

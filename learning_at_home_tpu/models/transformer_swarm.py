@@ -58,6 +58,10 @@ class SwarmTransformerConfig:
     # (both directions; servers compute in f32) — halves the DCN bytes of
     # the large-row dispatches that dominate swarm dispatch p50
     wire_dtype: Any = None
+    # wire CODEC pin ("none"/"bf16"/"f16"/"u8"/"blockq8"); None = adaptive
+    # per-pool escalation (client/moe.py wire_codec, docs/PROTOCOL.md) —
+    # 8-bit codecs quarter the DCN bytes vs f32
+    wire_codec: Any = None
     # > 0: debit each expert's SELECTION score by this × its endpoint's
     # RTT EMA (seconds) so routing avoids slow/overloaded peers
     # proactively (see client/moe.py latency_weight); 0 = off
@@ -85,6 +89,7 @@ class SwarmDMoETransformerLM:
                 backward_timeout=config.backward_timeout,
                 timeout_after_k_min=config.timeout_after_k_min,
                 wire_dtype=config.wire_dtype,
+                wire_codec=config.wire_codec,
                 latency_weight=config.latency_weight,
             )
             for i in range(config.n_layers)

@@ -48,8 +48,11 @@ import numpy as np
 
 from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
+    WIRE_CODECS,
     WIRE_DTYPES,
     WireTensors,
+    decode_wire_tensors,
+    encode_wire_tensors,
     frame_nbytes,
     is_float_dtype,
     pack_frames,
@@ -58,6 +61,7 @@ from learning_at_home_tpu.utils.serialization import (
     send_frame_parts,
     unpack_message,
     wire_cast,
+    wire_codec_name,
 )
 
 if TYPE_CHECKING:
@@ -70,7 +74,16 @@ logger = logging.getLogger(__name__)
 # negotiate (its dispatcher replies through handler._dispatch, where
 # ``hello`` lands in the unknown-message error path), so clients fall
 # back to protocol v1 against it — by design, not by accident.
-SERVER_FEATURES = ("mux",)
+# ``codec``: the request may carry the DICT wire form (quantized 8-bit
+# codecs with per-tensor headers — serialization.py, docs/PROTOCOL.md);
+# clients never offer quantized payloads to peers that did not echo it.
+SERVER_FEATURES = ("mux", "codec")
+
+# Reply payloads at least this large (decoded bytes) quantize in the
+# default executor, not on the serving loop — the server-side mirror of
+# the client's encode-on-the-host-thread contract.  Small replies encode
+# inline: a thread hop costs more than the quantize itself.
+ENCODE_OFFLOOP_BYTES = 1 << 18
 
 
 def upcast_from_wire(tensors, wire: str | None) -> list:
@@ -104,6 +117,34 @@ def upcast_from_wire(tensors, wire: str | None) -> list:
 def downcast_to_wire(tensors, wire: str | None) -> list:
     """Reply's floating tensors → the requester's wire dtype."""
     return wire_cast(tensors, wire or None)
+
+
+def decode_request_wire(tensors, wire) -> list:
+    """Request payload → compute tensors, both wire meta forms.
+
+    Legacy string form: the strict eager upcast above.  Dict (codec)
+    form: per-tensor validation with QUANTIZED tensors wrapped as
+    :class:`~learning_at_home_tpu.utils.serialization.LazyDecode` — the
+    dequantize runs on the Runtime thread, directly into the batch's
+    staging buffer, never on this serving loop."""
+    if isinstance(wire, dict):
+        return decode_wire_tensors(tensors, wire, lazy=True)
+    return upcast_from_wire(tensors, wire)
+
+
+async def encode_reply_wire(tensors, wire) -> tuple[list, dict | None]:
+    """Reply tensors → the requester's wire encoding.  Returns
+    ``(wire_tensors, reply_wire_meta)``; the meta is None for the legacy
+    forms (the downcast dtype is visible in the tensor specs).  Quantized
+    encodes of large replies run in the default executor so the serving
+    loop never spends milliseconds quantizing a 4 MB batch reply."""
+    if not isinstance(wire, dict):
+        return downcast_to_wire(tensors, wire), None
+    codec = wire.get("c")
+    nbytes = sum(np.asarray(t).nbytes for t in tensors)
+    if nbytes >= ENCODE_OFFLOOP_BYTES:
+        return await asyncio.to_thread(encode_wire_tensors, tensors, codec)
+    return encode_wire_tensors(tensors, codec)
 
 
 class ConnectionHandler:
@@ -174,6 +215,20 @@ class ConnectionHandler:
         async with wlock:
             await send_frame_parts(writer, parts)
 
+    @staticmethod
+    def _count_wire_bytes(wire, nbytes: int, direction: str) -> None:
+        """``lah_server_wire_bytes_total{codec=,direction=}``: data-plane
+        bytes by negotiated wire codec — the observable the byte-reduction
+        acceptance gates on.  One labeled counter inc per request/reply
+        (never per row); label cardinality is bounded by construction
+        (|WIRE_CODECS| x 2)."""
+        from learning_at_home_tpu.utils.metrics import registry
+
+        registry.counter(
+            "lah_server_wire_bytes_total",
+            "request/reply payload bytes by wire codec",
+        ).inc(nbytes, codec=wire_codec_name(wire), direction=direction)
+
     async def _serve_muxed(
         self, payload: bytes, rid: int, writer, wlock: asyncio.Lock
     ) -> None:
@@ -194,9 +249,9 @@ class ConnectionHandler:
     #      single-expert and multi-expert paths; raises on any failure ----
 
     async def _run_forward(
-        self, uid: str, tensors, wire: str | None = None,
+        self, uid: str, tensors, wire=None,
         trace: str | None = None,
-    ) -> list:
+    ) -> tuple[list, dict | None]:
         backend = self.server.experts.get(uid)
         if backend is None:
             raise ValueError(f"unknown expert uid: {uid!r}")
@@ -208,16 +263,16 @@ class ConnectionHandler:
                 f"expert {uid} takes {backend.n_inputs} inputs, "
                 f"got {len(tensors)}"
             )
-        tensors = upcast_from_wire(tensors, wire)
+        tensors = decode_request_wire(tensors, wire)
         result = await self.server.forward_pools[uid].submit_task(
             *tensors, trace=trace
         )
-        return downcast_to_wire(result, wire)
+        return await encode_reply_wire(result, wire)
 
     async def _run_backward(
-        self, uid: str, tensors, declared_n_inputs, wire: str | None = None,
+        self, uid: str, tensors, declared_n_inputs, wire=None,
         trace: str | None = None,
-    ) -> list:
+    ) -> tuple[list, dict | None]:
         backend = self.server.experts.get(uid)
         if backend is None:
             raise ValueError(f"unknown expert uid: {uid!r}")
@@ -249,11 +304,11 @@ class ConnectionHandler:
                 f"{expected or f'>{backend.n_inputs}'} tensors "
                 f"(inputs + grad_outputs), got {len(tensors)}"
             )
-        tensors = upcast_from_wire(tensors, wire)
+        tensors = decode_request_wire(tensors, wire)
         result = await self.server.backward_pools[uid].submit_task(
             *tensors, trace=trace
         )
-        return downcast_to_wire(result, wire)
+        return await encode_reply_wire(result, wire)
 
     async def _run_multi(self, tensors, meta, rid=None, trace=None) -> list:
         """Fan a merged request out to the local expert pools concurrently;
@@ -264,6 +319,18 @@ class ConnectionHandler:
         wire = meta.get("wire")
         if op not in ("forward", "backward") or not isinstance(parts, list):
             raise ValueError("multi needs op forward|backward and parts list")
+        # dict (codec) wire form: headers align 1:1 with the request's
+        # tensor concat — slice them per part exactly like the tensors
+        wire_headers = None
+        if isinstance(wire, dict):
+            wire_headers = wire.get("h")
+            if not isinstance(wire_headers, list) or len(wire_headers) != len(
+                tensors
+            ):
+                raise ValueError(
+                    "multi wire codec headers do not align with the "
+                    "request's tensors"
+                )
         slices = []
         off = 0
         for part in parts:
@@ -272,26 +339,32 @@ class ConnectionHandler:
             n = part.get("n_tensors")
             if not isinstance(n, int) or n < 0 or off + n > len(tensors):
                 raise ValueError("multi part tensor counts are inconsistent")
-            slices.append((part, tensors[off : off + n]))
+            part_wire = wire
+            if wire_headers is not None:
+                part_wire = {"c": wire.get("c"),
+                             "h": wire_headers[off : off + n]}
+            slices.append((part, tensors[off : off + n], part_wire))
             off += n
         if off != len(tensors):
             raise ValueError(
                 f"multi parts cover {off} tensors, request has {len(tensors)}"
             )
 
-        async def run_part(part, part_tensors):
+        async def run_part(part, part_tensors, part_wire):
             uid = part.get("uid")
             if op == "forward":
-                return await self._run_forward(uid, part_tensors, wire, trace)
+                return await self._run_forward(
+                    uid, part_tensors, part_wire, trace
+                )
             return await self._run_backward(
-                uid, part_tensors, part.get("n_inputs"), wire, trace
+                uid, part_tensors, part.get("n_inputs"), part_wire, trace
             )
 
         settled = await asyncio.gather(
-            *(run_part(p, t) for p, t in slices), return_exceptions=True
+            *(run_part(p, t, w) for p, t, w in slices), return_exceptions=True
         )
-        reply_parts, reply_tensors = [], []
-        for (part, _), result in zip(slices, settled):
+        reply_parts, reply_tensors, reply_headers = [], [], []
+        for (part, _t, _w), result in zip(slices, settled):
             uid = part.get("uid")
             if isinstance(result, BaseException):
                 logger.warning(
@@ -302,11 +375,21 @@ class ConnectionHandler:
                      "message": f"{type(result).__name__}: {result}"}
                 )
             else:
+                part_tensors, part_wire = result
                 reply_parts.append(
-                    {"uid": uid, "ok": True, "n_tensors": len(result)}
+                    {"uid": uid, "ok": True, "n_tensors": len(part_tensors)}
                 )
-                reply_tensors.extend(result)
+                reply_tensors.extend(part_tensors)
+                if isinstance(part_wire, dict):
+                    reply_headers.extend(part_wire["h"])
         reply_meta = {"parts": reply_parts}
+        if isinstance(wire, dict) and len(reply_headers) == len(reply_tensors) \
+                and reply_tensors:
+            # per-part encodes concatenate like the tensors themselves:
+            # one header entry per reply tensor, in parts order.  (A dict
+            # request whose codec is a plain downcast produces no headers
+            # — the reply then travels like the legacy form.)
+            reply_meta["wire"] = {"c": wire.get("c"), "h": reply_headers}
         if trace is not None:
             reply_meta["trace"] = trace  # echo: the reply joins the trace
         return pack_frames(
@@ -405,6 +488,14 @@ class ConnectionHandler:
                 msg_type, WireTensors.prepare(tensors), meta, rid=rid
             )
 
+        def wire_reply(result: tuple) -> list:
+            """``result`` is an ``encode_reply_wire`` pair: tensors plus
+            the reply's wire meta (dict codec form only — the legacy
+            downcast needs no meta, its dtype is in the tensor specs)."""
+            tensors, rwire = result
+            meta = {"wire": rwire} if isinstance(rwire, dict) else None
+            return reply("result", tensors, meta)
+
         try:
             msg_type, tensors, meta = unpack_message(payload)
         except Exception as e:
@@ -414,28 +505,47 @@ class ConnectionHandler:
         trace = meta.get("trace")
         if not (isinstance(trace, str) and 0 < len(trace) <= 64):
             trace = None  # malformed/absent: never trust peer-supplied meta
-        if wire is not None and wire not in WIRE_DTYPES:
+        if isinstance(wire, str) and wire not in WIRE_DTYPES:
             return reply(
                 "error",
                 meta={"message": f"unsupported wire dtype {wire!r}; "
                       f"supported: {WIRE_DTYPES}"},
             )
+        if isinstance(wire, dict) and wire.get("c") not in WIRE_CODECS:
+            return reply(
+                "error",
+                meta={"message": f"unsupported wire codec {wire.get('c')!r}; "
+                      f"supported: {WIRE_CODECS}"},
+            )
+        if wire is not None and not isinstance(wire, (str, dict)):
+            return reply(
+                "error",
+                meta={"message": "malformed wire meta: expected a dtype "
+                      "string or a codec map"},
+            )
+        data_plane = msg_type in ("forward", "backward", "multi")
+        if data_plane:
+            self._count_wire_bytes(wire, len(payload), "rx")
         try:
             with timeline.span(f"server.request.{msg_type}", trace=trace):
                 if msg_type == "forward":
-                    return reply(
-                        "result",
-                        await self._run_forward(uid, tensors, wire, trace),
+                    out = wire_reply(
+                        await self._run_forward(uid, tensors, wire, trace)
                     )
+                    self._count_wire_bytes(wire, frame_nbytes(out), "tx")
+                    return out
                 elif msg_type == "backward":
-                    return reply(
-                        "result",
+                    out = wire_reply(
                         await self._run_backward(
                             uid, tensors, meta.get("n_inputs"), wire, trace
-                        ),
+                        )
                     )
+                    self._count_wire_bytes(wire, frame_nbytes(out), "tx")
+                    return out
                 elif msg_type == "multi":
-                    return await self._run_multi(tensors, meta, rid, trace)
+                    out = await self._run_multi(tensors, meta, rid, trace)
+                    self._count_wire_bytes(wire, frame_nbytes(out), "tx")
+                    return out
                 elif msg_type == "info":
                     backend = self.server.experts.get(uid)
                     if backend is None:

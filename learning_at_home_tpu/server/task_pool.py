@@ -35,7 +35,17 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from learning_at_home_tpu.utils.serialization import LazyDecode
+
 logger = logging.getLogger(__name__)
+
+
+def _as_task_tensor(t):
+    """Batch-formation view of a task tensor: quantized wire payloads
+    (``LazyDecode``) expose shape/dtype for validation but are NOT
+    materialized here — their dequantize runs on the Runtime thread,
+    directly into the staging buffer (``BatchJob.stack``)."""
+    return t if isinstance(t, LazyDecode) else np.asarray(t)
 
 
 def bucket_rows(n: int, max_batch_size: int) -> int:
@@ -82,7 +92,12 @@ class BatchJob:
         (no buffer checked out).
         """
         if len(self.task_tensors) == 1 and self.target_rows == self.n_rows:
-            return list(self.task_tensors[0]), []
+            # zero-copy pass-through for raw tensors; a quantized payload
+            # decodes HERE (Runtime thread) — never on the event loop
+            return [
+                t.decode() if isinstance(t, LazyDecode) else t
+                for t in self.task_tensors[0]
+            ], []
         buffers: list = []
         inputs: list = []
         for i in range(len(self.task_tensors[0])):
@@ -95,7 +110,13 @@ class BatchJob:
             off = 0
             for tensors in self.task_tensors:
                 part = tensors[i]
-                buf[off : off + part.shape[0]] = part
+                if isinstance(part, LazyDecode):
+                    # dequantize straight into the staging rows: the wire
+                    # payload's only f32 materialization is the batch
+                    # buffer itself
+                    part.decode_into(buf[off : off + part.shape[0]])
+                else:
+                    buf[off : off + part.shape[0]] = part
                 off += part.shape[0]
             if off < self.target_rows:
                 buf[off:] = 0  # recycled buffers hold the previous batch
@@ -235,9 +256,9 @@ class TaskPool:
         # arity mismatch raises; dtype differences PROMOTE via
         # np.result_type, e.g. a stray f64 task widens the batch) instead
         # of surfacing later as a runtime-side stacking error
-        first = [np.asarray(t) for t in batch[0].tensors]
+        first = [_as_task_tensor(t) for t in batch[0].tensors]
         tasks = [tuple(first)]
-        dtypes = [a.dtype for a in first]
+        dtypes = [np.dtype(a.dtype) for a in first]
         for t in batch[1:]:
             if len(t.tensors) != len(first):
                 raise ValueError(
@@ -245,7 +266,7 @@ class TaskPool:
                 )
             coerced = []
             for i, tensor in enumerate(t.tensors):
-                arr = np.asarray(tensor)
+                arr = _as_task_tensor(tensor)
                 if arr.shape[1:] != first[i].shape[1:]:
                     raise ValueError(
                         f"task tensor {i} is {arr.dtype}{arr.shape}, batch "

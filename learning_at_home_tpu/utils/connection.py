@@ -34,6 +34,7 @@ from learning_at_home_tpu.utils.asyncio_utils import asyncio_timeout
 from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
     WireTensors,
+    decode_wire_tensors,
     frame_nbytes,
     pack_frames,
     peek_header,
@@ -47,8 +48,17 @@ logger = logging.getLogger(__name__)
 Endpoint = tuple[str, int]
 
 # Features this client offers in its ``hello``; a server echoes the subset
-# it speaks.  "mux" = request-id-tagged frames, many RPCs per socket.
-CLIENT_FEATURES = ("mux",)
+# it speaks.  "mux" = request-id-tagged frames, many RPCs per socket;
+# "codec" = the peer understands the dict wire form (quantized 8-bit
+# codecs with per-tensor headers) — quantized payloads are only ever
+# offered to pools whose hello echoed it (v1 peers, old builds and the
+# DHT's own handlers transparently stay on the raw/bf16 wire).
+CLIENT_FEATURES = ("mux", "codec")
+
+# Exchanges moving at least this many bytes update the pool's bandwidth
+# EMA: smaller exchanges are latency- and compute-dominated and would
+# report the handshake (or a warmup compile), not the pipe.
+BW_MIN_SAMPLE_BYTES = 256 << 10
 
 # Cancellation message the quorum fan-out attaches when it cancels a
 # straggler AFTER the grace period (``task.cancel(msg=...)``).  An
@@ -172,12 +182,22 @@ class ConnectionPool:
         self.inflight = 0
         self.inflight_max = 0
         self.bytes_sent = 0
+        self.bytes_received = 0
         # EMA of successful whole-exchange times (seconds), excluding the
         # local semaphore wait: covers network RTT AND the peer's queueing
         # + compute, so it doubles as a load signal.  Consumed by the
         # MoE's latency-aware expert selection (client/moe.py
         # ``latency_weight``); None until the first success.
         self.rtt_ema: Optional[float] = None
+        # EMA of observed bytes/sec over large exchanges (request+reply
+        # bytes / whole-exchange time — an UNDERestimate, since the
+        # denominator includes the peer's queueing and compute, which
+        # only makes the adaptive codec selector escalate sooner on
+        # loaded pools).  None until a ≥BW_MIN_SAMPLE_BYTES exchange.
+        self.bw_ema: Optional[float] = None
+        # features the peer's hello_ok echoed; () until v2 negotiation
+        # succeeds (v1 pools never advertise any)
+        self.features: tuple = ()
 
     # ---- shared plumbing ----
 
@@ -195,6 +215,22 @@ class ConnectionPool:
             dt if self.rtt_ema is None else 0.8 * self.rtt_ema + 0.2 * dt
         )
 
+    def supports(self, feature: str) -> bool:
+        """True once the peer's hello_ok advertised ``feature`` — the
+        per-pool pin the codec selection consults before offering any
+        quantized payload."""
+        return feature in self.features
+
+    async def ensure_negotiated(self, timeout: Optional[float] = None) -> None:
+        """Force the hello exchange NOW if this pool has never contacted
+        its peer, so :meth:`supports` answers definitively before the
+        caller commits to a wire encoding (the averaging chunk sender's
+        hook; idempotent, serialized on the negotiation lock)."""
+        if self._proto is None and self._negotiate_v2 and (
+            self._require_v2 or _v2_enabled()
+        ):
+            await self._negotiate(timeout)
+
     @staticmethod
     def _is_latency_signal(e: BaseException) -> bool:
         """Failures whose elapsed time IS slowness evidence: timeouts and
@@ -210,7 +246,8 @@ class ConnectionPool:
             and e.args[0] == QUORUM_STRAGGLER_CANCEL
         )
 
-    def _finish(self, payload: bytes, dt: float):
+    def _finish(self, payload: bytes, dt: float, sent_bytes: int = 0):
+        self.bytes_received += len(payload)
         reply_type, reply_tensors, reply_meta = unpack_message(payload)
         if reply_type == "error":
             # error replies are typically the FASTEST exchanges (no expert
@@ -220,6 +257,25 @@ class ConnectionPool:
                 f"{self.endpoint}: {reply_meta.get('message', 'unknown error')}"
             )
         self._update_rtt(dt)
+        moved = sent_bytes + len(payload)
+        if moved >= BW_MIN_SAMPLE_BYTES and dt > 0:
+            bw = moved / dt
+            self.bw_ema = (
+                bw if self.bw_ema is None else 0.8 * self.bw_ema + 0.2 * bw
+            )
+        rwire = reply_meta.get("wire") if isinstance(reply_meta, dict) else None
+        if isinstance(rwire, dict):
+            # quantized reply: validate headers HERE (a malformed reply is
+            # a failed exchange), but wrap as LazyDecode — the dequantize
+            # runs on the consumer's host thread, not this event loop
+            try:
+                reply_tensors = decode_wire_tensors(
+                    reply_tensors, rwire, lazy=True
+                )
+            except ValueError as e:
+                raise RemoteCallError(
+                    f"{self.endpoint}: malformed wire codec reply: {e}"
+                )
         return reply_tensors, reply_meta
 
     # ---- public entry points ----
@@ -285,7 +341,8 @@ class ConnectionPool:
                 async with asyncio_timeout(timeout):
                     reader, writer = await self._acquire()
                     parts = pack_frames(msg_type, wire, meta)
-                    self.bytes_sent += frame_nbytes(parts)
+                    sent = frame_nbytes(parts)
+                    self.bytes_sent += sent
                     await send_frame_parts(writer, parts)
                     payload = await recv_frame(reader)
             except BaseException as e:
@@ -296,7 +353,7 @@ class ConnectionPool:
                 raise
             dt = loop.time() - t0
             self._free.put_nowait((reader, writer))
-        return self._finish(payload, dt)
+        return self._finish(payload, dt, sent)
 
     # ---- protocol v2: negotiation + multiplexed exchanges ----
 
@@ -345,6 +402,10 @@ class ConnectionPool:
                 raise
             if rtype == "hello_ok" and "mux" in (rmeta.get("features") or []):
                 self._proto = 2
+                self.features = tuple(
+                    f for f in CLIENT_FEATURES
+                    if f in (rmeta.get("features") or [])
+                )
                 self._mux = _MuxConnection(reader, writer)
             elif self._require_v2:
                 # a require_v2 pool must NEVER silently run v1 (held
@@ -397,8 +458,13 @@ class ConnectionPool:
                     )
                 # the peer restarted as an older build: demote the pool
                 self._proto = 1
+                self.features = ()
                 self._free.put_nowait((reader, writer))
                 raise _ProtocolDowngraded()
+            self.features = tuple(
+                f for f in CLIENT_FEATURES
+                if f in (rmeta.get("features") or [])
+            )
             self._mux = _MuxConnection(reader, writer)
             return self._mux
 
@@ -417,7 +483,8 @@ class ConnectionPool:
                     fut = loop.create_future()
                     mux.pending[rid] = fut
                     parts = pack_frames(msg_type, wire, meta, rid=rid)
-                    self.bytes_sent += frame_nbytes(parts)
+                    sent = frame_nbytes(parts)
+                    self.bytes_sent += sent
                     async with mux.wlock:
                         await send_frame_parts(mux.writer, parts)
                     payload = await fut
@@ -437,7 +504,7 @@ class ConnectionPool:
                 raise
             finally:
                 self.inflight -= 1
-            return self._finish(payload, loop.time() - t0)
+            return self._finish(payload, loop.time() - t0, sent)
 
     def close(self) -> None:
         while not self._free.empty():

@@ -49,3 +49,89 @@ def pin_cpu_if_axon(reason: str = "") -> None:
         jax.config.update("jax_platforms", "cpu")
         why = reason or "axon plugin lacks the host callbacks this path needs"
         print(f"# pinned JAX to cpu ({why})", flush=True)
+
+
+def find_orphan_servers(exclude_descendants_of: Optional[int] = None) -> list:
+    """Scan /proc for ``learning_at_home_tpu.server`` processes left over
+    from a PRIOR session.  Orphans silently load the (single) core and
+    corrupt every absolute CPU timing taken while they live — three
+    round-4 churn servers ran ~6 h into round 5 and invalidated its
+    morning's numbers (ROUND5_NOTES "hazards").  Timing entry points
+    (bench.py, tools/collect_gate.py) call this BEFORE spawning anything,
+    so every match is by definition not ours.
+
+    Returns ``[(pid, age_seconds, cmdline), ...]``; empty off-Linux (no
+    /proc) — the guard degrades to a no-op rather than guessing.
+    ``exclude_descendants_of`` skips processes whose parent chain reaches
+    that pid (a concurrently-running sibling launcher we own)."""
+    import time
+
+    out: list = []
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return out
+    try:
+        boot = time.time() - float(
+            open("/proc/uptime").read().split()[0]
+        )
+        clock_tck = os.sysconf("SC_CLK_TCK")
+    except Exception:
+        boot, clock_tck = None, 100
+
+    def parent_of(pid: int) -> Optional[int]:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                # field 4 (after the parenthesised comm, which may
+                # contain spaces)
+                rest = f.read().rsplit(")", 1)[1].split()
+                return int(rest[1])
+        except Exception:
+            return None
+
+    def is_descendant(pid: int, ancestor: int) -> bool:
+        seen = 0
+        while pid and pid != 1 and seen < 64:
+            if pid == ancestor:
+                return True
+            pid = parent_of(pid) or 0
+            seen += 1
+        return False
+
+    me = os.getpid()
+    for pid_s in pids:
+        pid = int(pid_s)
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = [
+                    a.decode("utf-8", "replace")
+                    for a in f.read().split(b"\0") if a
+                ]
+        except OSError:
+            continue
+        # exact argv token (the ``-m learning_at_home_tpu.server`` module
+        # arg): a shell whose ONE-token script merely mentions the module
+        # (this very scan, a grep) must not match
+        if "learning_at_home_tpu.server" not in argv:
+            continue
+        cmdline = " ".join(argv).strip()
+        if is_descendant(pid, me):
+            continue  # our own child (a launcher scanning mid-run)
+        if exclude_descendants_of and is_descendant(
+            pid, exclude_descendants_of
+        ):
+            continue
+        age = None
+        if boot is not None:
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    start_ticks = int(
+                        f.read().rsplit(")", 1)[1].split()[19]
+                    )
+                age = round(time.time() - (boot + start_ticks / clock_tck), 1)
+            except Exception:
+                age = None
+        out.append((pid, age, cmdline[:200]))
+    return out

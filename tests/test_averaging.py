@@ -489,3 +489,77 @@ def test_session_blocking_round_and_stats(dht):
     finally:
         sa.shutdown()
         sb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# quantized wire chunks (ISSUE 5): only the wire compresses — the f32
+# sorted-peer reduction and the bitwise-equality contract are untouched
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_wire_keeps_members_bitwise_identical(dht):
+    """With blockq8 chunks, every member must still end with IDENTICAL
+    bytes per reduced partition (replies stay raw f32 — one exact result
+    distribution), within quantization error of the true mean, with the
+    contribute direction actually quantized (counter + bytes)."""
+    cfg = AveragingConfig(min_group_size=3, max_group_size=3,
+                          part_timeout=3.0, chunk_elems=1 << 10,
+                          wire_codec="blockq8")
+    avs = _spawn(dht, 3, cfg)
+    trees = [_make_tree(i, d=997) for i in range(3)]
+    try:
+        results, errors = _run_rounds(avs, trees)
+        assert not errors, errors
+        outs = [r[0] for r in results]
+        for r in results:
+            assert not r[1]["degraded"], r[1]
+        for other in outs[1:]:
+            for la, lb in zip(jax.tree.leaves(outs[0]),
+                              jax.tree.leaves(other)):
+                np.testing.assert_array_equal(
+                    np.asarray(la), np.asarray(lb)
+                )
+        exact = jax.tree.map(lambda *xs: sum(xs) / len(xs), *trees)
+        for la, le in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(exact)):
+            err = float(np.abs(np.asarray(la) - np.asarray(le)).max())
+            assert err < 0.1, err  # quantization-bounded, not exact
+        stats = [av.stats() for av in avs]
+        assert all(s["quantized_chunks"] > 0 for s in stats), stats
+        assert all(s["wire_codec"] == "blockq8" for s in stats)
+        # contribute direction really shrank: quantized bytes received
+        # are well under the raw-f32 volume a ``none`` round would move
+        raw_per_owner = sum(t.size for t in jax.tree.leaves(trees[0])) * 4
+        for s in stats:
+            assert s["bytes_received"] < raw_per_owner, (
+                s["bytes_received"], raw_per_owner,
+            )
+    finally:
+        for av in avs:
+            av.shutdown()
+
+
+def test_quantized_wire_falls_back_against_no_codec_owner(dht, monkeypatch):
+    """An owner whose hello does not advertise ``codec`` (old build) must
+    transparently receive raw f32 chunks — the round still completes and
+    stays exact."""
+    from learning_at_home_tpu.averaging import handler as avg_handler
+
+    monkeypatch.setattr(avg_handler, "AVERAGING_FEATURES", ("mux",))
+    cfg = AveragingConfig(min_group_size=2, max_group_size=2,
+                          part_timeout=3.0, wire_codec="u8")
+    a, b = _spawn(dht, 2, cfg)
+    trees = [_make_tree(0), _make_tree(1)]
+    try:
+        results, errors = _run_rounds([a, b], trees)
+        assert not errors, errors
+        (tree_a, info_a), (tree_b, _) = results
+        assert not info_a["degraded"]
+        want = jax.tree.map(lambda x, y: (x + y) / 2, *trees)
+        for la, lw in zip(jax.tree.leaves(tree_a), jax.tree.leaves(want)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lw))
+        # nothing arrived quantized: the senders saw no codec feature
+        assert a.stats()["quantized_chunks"] == 0
+        assert b.stats()["quantized_chunks"] == 0
+    finally:
+        a.shutdown()
+        b.shutdown()

@@ -202,3 +202,35 @@ def test_train_lm_multi_trainer_async_dp():
     assert 0 < updates <= total_sent, summary
     assert updates > max_single, summary  # both trainers' work was applied
     assert summary["experts_updated"] >= 3, summary  # load spread over grid
+
+
+@pytest.mark.slow
+def test_train_lm_swarm_blockq8_loss_parity():
+    """Quality parity gate (ISSUE 5): a short swarm run whose dispatch
+    wire is pinned to ``blockq8`` must track the uncompressed run's loss
+    curve within the run-to-run band this smoke class tolerates (same
+    seed, same data; async interleaving is the residual noise source).
+    Guards against a quantizer that silently degrades training while
+    every per-RPC check still passes."""
+    base = [
+        "experiments/train_lm.py", "--mode", "swarm",
+        "--subprocess-servers", "--steps", "10",
+        "--experts-per-layer", "2", "--n-servers", "1",
+        "--n-layers", "1", "--batch-size", "2", "--d-model", "32",
+        "--seq-len", "16", "--log-every", "1", "--lr", "0.005",
+        "--seed", "0",
+    ]
+    curves = {}
+    for codec in ("none", "blockq8"):
+        lines = run_script(
+            base + (["--wire-codec", codec] if codec != "none" else []),
+            timeout=420,
+        )
+        losses = [l["loss"] for l in lines if "loss" in l]
+        assert losses and all(math.isfinite(v) for v in losses), lines
+        curves[codec] = losses
+    # both curves fall, and the quantized endpoint sits inside the band
+    # the async-dp smoke uses for run-to-run drift (0.5 nats)
+    for codec, losses in curves.items():
+        assert losses[-1] < losses[0], (codec, losses)
+    assert abs(curves["blockq8"][-1] - curves["none"][-1]) <= 0.5, curves

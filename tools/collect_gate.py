@@ -28,6 +28,36 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def orphan_guard() -> int:
+    """REFUSE to run (rc=4, PIDs printed) when prior-session
+    ``learning_at_home_tpu.server`` orphans are alive: they load the
+    single core and every timing this gate (and the tier-1 run after
+    it) takes would be corrupted — the round-4 churn servers silently
+    poisoned ~6 h of round-5 numbers (ROUND5_NOTES hazards).  Kill the
+    PIDs and re-run, or set LAH_IGNORE_ORPHANS=1 to proceed anyway."""
+    sys.path.insert(0, REPO)
+    try:
+        from learning_at_home_tpu.utils.subproc import find_orphan_servers
+
+        orphans = find_orphan_servers()
+    except Exception as e:
+        print(f"collect_gate: orphan scan failed ({e}); continuing",
+              file=sys.stderr)
+        return 0
+    if not orphans:
+        return 0
+    for pid, age, cmd in orphans:
+        print(f"collect_gate: ORPHAN server pid={pid} age={age}s: {cmd}",
+              file=sys.stderr)
+    if os.environ.get("LAH_IGNORE_ORPHANS") == "1":
+        print("collect_gate: LAH_IGNORE_ORPHANS=1 — proceeding on a DIRTY "
+              "box", file=sys.stderr)
+        return 0
+    print("collect_gate: REFUSING — kill the orphan PIDs above (kill -9 "
+          "<pid>) or set LAH_IGNORE_ORPHANS=1", file=sys.stderr)
+    return 4
+
+
 def smoke_worker() -> int:
     """One fwd+bwd RPC per protocol version against an in-process server;
     numerics must agree across protocols and v2 must actually negotiate."""
@@ -73,7 +103,76 @@ def smoke_worker() -> int:
     rc = averaging_smoke()
     if rc:
         return rc
+    rc = codec_smoke()
+    if rc:
+        return rc
     return telemetry_smoke()
+
+
+def codec_smoke() -> int:
+    """Quantized wire-codec gate (ISSUE 5): one fwd+bwd dispatch through
+    a real server under ``u8`` and ``blockq8``, asserting (a) the codec
+    actually negotiated (not silently fallen back to raw), (b) wire
+    bytes reduced ≥ 3.5× vs the ``none`` run, and (c) per-run input
+    gradient cosine ≥ 0.99 vs uncompressed — the quality story is
+    measured here on every gate run, not asserted."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+    from learning_at_home_tpu.client.rpc import pool_registry
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.server.server import background_server
+
+    hid, rows = 256, 256
+    with background_server(
+        num_experts=2, hidden_dim=hid, expert_prefix="cs", seed=0,
+        optimizer=optax.sgd(0.0),  # frozen params: runs must be comparable
+    ) as (endpoint, srv):
+        source = StaticExpertSource({uid: endpoint for uid in srv.experts})
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(rows, hid).astype(np.float32)
+        )
+        grads, bytes_per = {}, {}
+        for codec in ("none", "u8", "blockq8"):
+            moe = RemoteMixtureOfExperts(
+                in_features=hid, grid_size=(2,), uid_prefix="cs",
+                source=source, k_best=2, k_min=2, wire_codec=codec,
+            )
+            gate = moe.init_gate_params(jax.random.PRNGKey(0))
+
+            def loss(xx):
+                return jnp.sum(moe(xx, gate) ** 2)
+
+            pool = pool_registry().get(endpoint)
+            b0 = pool.bytes_sent + pool.bytes_received
+            grads[codec] = np.asarray(jax.grad(loss)(x))
+            bytes_per[codec] = pool.bytes_sent + pool.bytes_received - b0
+            if codec != "none":
+                counts = moe.dispatch_stats()["codecs"]
+                assert counts.get(codec, 0) > 0, (
+                    f"{codec} did not negotiate; payloads used {counts}"
+                )
+        for codec in ("u8", "blockq8"):
+            reduction = bytes_per["none"] / max(bytes_per[codec], 1)
+            g0, g1 = grads["none"], grads[codec]
+            cos = float(
+                (g0 * g1).sum()
+                / (np.linalg.norm(g0) * np.linalg.norm(g1) + 1e-12)
+            )
+            assert reduction >= 3.5, (
+                f"{codec} wire reduction {reduction:.2f}x < 3.5x "
+                f"({bytes_per})"
+            )
+            assert cos >= 0.99, f"{codec} gradient cosine {cos:.4f} < 0.99"
+            print(f"codec {codec}: bytes /{reduction:.2f}, "
+                  f"grad_cosine {cos:.5f}")
+    reset_client_rpc()
+    print("CODEC_SMOKE_OK codecs=u8,blockq8")
+    return 0
 
 
 def averaging_smoke() -> int:
@@ -208,9 +307,9 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # three smokes now (client path, averaging, telemetry+lah_top
-            # subprocess): a wider default bound than the collect gate's
-            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "420")),
+            # four smokes now (client path, averaging, codec, telemetry+
+            # lah_top subprocess): a wider default bound than the gate's
+            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "540")),
         )
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
@@ -219,6 +318,7 @@ def run_smoke() -> int:
         r.returncode != 0
         or "SMOKE_OK" not in r.stdout
         or "AVG_SMOKE_OK" not in r.stdout
+        or "CODEC_SMOKE_OK" not in r.stdout
         or "TELEMETRY_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
@@ -231,6 +331,9 @@ def run_smoke() -> int:
 
 
 def main() -> int:
+    rc = orphan_guard()  # BEFORE any timing work (smokes spawn servers)
+    if rc:
+        return rc
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     try:
