@@ -1,0 +1,17 @@
+"""Static analysis for the swarm's concurrency invariants (ISSUE 6).
+
+``lah_lint`` (tools/lah_lint.py fronts :mod:`.lint`) encodes the
+threading rules the runtime sanitizer (utils/sanitizer.py) checks
+dynamically — the static layer catches the violation at review time, the
+runtime layer catches whatever slips through.  docs/CONCURRENCY.md is
+the prose contract both layers enforce.
+"""
+
+from learning_at_home_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    RULES,
+    format_findings,
+    lint_paths,
+)
+
+__all__ = ["Finding", "RULES", "format_findings", "lint_paths"]

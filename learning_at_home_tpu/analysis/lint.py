@@ -1,0 +1,577 @@
+"""lah-lint: AST rules for the repo's threading/wire invariants (ISSUE 6).
+
+Every rule encodes an invariant this codebase has already been burned by
+(or nearly so) — the rules are repo-specific on purpose:
+
+- **R1**  no blocking calls inside ``async def`` bodies.  Every
+  ``async def`` in this package runs on one of the process's event loops
+  (``lah-client``, the server's serving loop, ``lah-metrics``,
+  ``lah-avg``, ``lah-dht``); a blocking call there stalls every
+  connection that loop serves.  Flagged: ``time.sleep``, subprocess
+  spawns, file I/O (``open``, ``numpy.load``/``save``), serialization
+  work (``pack_message``, ``wire_cast``, ``encode_wire_tensors``,
+  ``WireTensors.prepare`` with a payload, ``EncodedBatch.encode``), and
+  un-awaited ``.acquire()`` without a timeout.
+- **R2**  no blocking future waits that can self-deadlock a loop: any
+  ``.result()`` inside an ``async def``, ``<loop>.run(...)`` inside an
+  ``async def``, and the ``run_coroutine_threadsafe(...).result()``
+  chain anywhere — the exact shape of the jitted-client ``io_callback``
+  hang (ROUND5 hazards; utils/asyncio_utils.BackgroundLoop.run carries
+  the matching runtime guard).
+- **R3**  per-pool fan-out constants (``MAX_CHUNKS_PER_PART`` and kin,
+  pattern ``MAX_(CHUNKS|RPCS|PARTS|CALLS)_PER_*``) must be statically
+  **below** every ``max_inflight`` default in the linted tree: held
+  replies need all of a partition's chunk RPCs admitted concurrently or
+  reduction deadlocks-until-timeout (averaging/averager.py).
+- **R4**  a module that speaks the held-reply protocol (references
+  ``avg_part``) must construct its pools with ``require_v2=True`` —
+  held replies on v1's one-RPC-per-socket discipline starve the pool.
+- **R5**  msgpack meta maps use string keys only: dict literals passed
+  as ``meta`` to ``pack_message``/``pack_frames``/``rpc``/
+  ``rpc_prepared`` (or to ``MSGPackSerializer.dumps``/``msgpack.packb``)
+  with non-string literal keys.  Int keys round-trip fine through
+  msgpack but broke the ``stats`` RPC consumers once already (PR 1).
+- **R6**  no bare ``except:`` and no swallowed broad handler
+  (``except Exception:`` / ``except BaseException:`` whose whole body is
+  ``pass``) — a swarm that eats its own failures cannot be debugged.
+- **R7**  a locally-defined coroutine called as a bare statement is
+  never scheduled (``foo()`` instead of ``await foo()``) — it silently
+  does nothing.
+
+Suppressions: ``# lah-lint: ignore[R1]`` (or ``ignore[R1,R5]``) on the
+finding's line, or on a standalone comment line directly above it,
+baselines the finding; add a reason after the bracket.  Suppressed
+findings still appear with ``--list-suppressed``.  The merged tree lints
+clean: ``python tools/lah_lint.py learning_at_home_tpu/`` exits 0.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Optional
+
+RULES = {
+    "R1": "blocking call inside an async function (event-loop stall)",
+    "R2": "blocking future wait that can self-deadlock an event loop",
+    "R3": "fan-out constant not statically below the mux in-flight limit",
+    "R4": "held-reply pool constructed without require_v2=True",
+    "R5": "msgpack meta dict with non-string keys",
+    "R6": "bare or swallowed broad exception handler",
+    "R7": "coroutine called without await (never scheduled)",
+}
+
+_SUPPRESS_RE = re.compile(r"lah-lint:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+# R1 canonical blocking callables (after import-alias resolution)
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system", "os.popen",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "numpy.load", "numpy.save", "numpy.savez", "numpy.savez_compressed",
+    "numpy.loadtxt", "numpy.savetxt",
+    "socket.create_connection",
+    "requests.get", "requests.post", "requests.put", "requests.request",
+}
+# serialization work recognized by bare name (how this repo imports them)
+_SERIALIZATION_FUNCS = {"pack_message", "wire_cast", "encode_wire_tensors"}
+
+_FANOUT_CONST_RE = re.compile(r"^MAX_(CHUNKS|RPCS|PARTS|CALLS)_PER_[A-Z_]+$")
+
+_META_CALLS = {  # callee tail -> positional index of the meta argument
+    "pack_message": 2,
+    "pack_frames": 2,
+    "rpc": 2,
+    "rpc_prepared": 2,
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag}: {self.message}"
+
+
+def _dotted(node: ast.AST, aliases: dict) -> Optional[str]:
+    """Resolve a call target to a dotted name through import aliases
+    (``np.load`` -> ``numpy.load``); None when the base is dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+    return None
+
+
+def _suppressions(source: str) -> dict[int, set]:
+    """line -> rule-ids suppressed there.  A suppression comment covers
+    its own line; a comment-only line covers the next CODE line (comment
+    blocks pass through — the marker may sit anywhere in a multi-line
+    explanation above the finding)."""
+    out: dict[int, set] = {}
+    lines = source.splitlines()
+
+    def _is_comment_or_blank(idx0: int) -> bool:
+        s = lines[idx0].strip() if idx0 < len(lines) else ""
+        return not s or s.startswith("#")
+
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            line = tok.start[0]
+            out.setdefault(line, set()).update(rules)
+            if tok.line.strip().startswith("#"):  # standalone comment line
+                nxt = line  # 1-based; lines[nxt] is the NEXT line (0-based)
+                while nxt < len(lines) and _is_comment_or_blank(nxt):
+                    nxt += 1
+                out.setdefault(nxt + 1, set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class _ModuleFacts:
+    """Per-module inputs to the cross-module rules R3/R4."""
+
+    def __init__(self) -> None:
+        self.fanout_consts: list[tuple[int, int, str, int]] = []  # line,col,name,val
+        self.inflight_defaults: list[tuple[int, int]] = []  # line,val
+        self.mentions_avg_part = False
+        self.pool_ctor_calls: list[tuple[int, int, str, bool]] = []  # line,col,name,has_require_v2
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self.facts = _ModuleFacts()
+        self.aliases: dict[str, str] = {}
+        self._func_stack: list[ast.AST] = []  # enclosing function defs
+        self._class_stack: list[str] = []
+        self._awaited: set[int] = set()
+        # names of locally-defined coroutines (module funcs and methods)
+        self.async_funcs: set[str] = set()
+        self.async_methods: dict[str, set] = {}
+
+    # -- helpers ----------------------------------------------------------
+
+    def _add(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.path, node.lineno, node.col_offset, rule, msg)
+        )
+
+    def _in_async(self) -> bool:
+        return bool(self._func_stack) and isinstance(
+            self._func_stack[-1], ast.AsyncFunctionDef
+        )
+
+    # -- structure --------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.aliases[a.asname or a.name] = f"{node.module}.{a.name}" if node.module else a.name
+        self.generic_visit(node)
+
+    def _collect_defaults(self, node) -> None:
+        # align trailing defaults with trailing args (positional part)
+        pos_args = node.args.args
+        pos_defaults = node.args.defaults
+        pairs = list(zip(pos_args[len(pos_args) - len(pos_defaults):], pos_defaults))
+        pairs += [
+            (a, d)
+            for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults)
+            if d is not None
+        ]
+        for arg, default in pairs:
+            if (
+                arg.arg == "max_inflight"
+                and isinstance(default, ast.Constant)
+                and isinstance(default.value, int)
+            ):
+                self.facts.inflight_defaults.append((node.lineno, default.value))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._collect_defaults(node)
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        # async_funcs / async_methods are filled by lint_paths' pre-pass
+        # (call sites may lexically precede the definitions they target)
+        self._collect_defaults(node)
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_fanout_const(node.targets[0] if node.targets else None, node.value, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._check_fanout_const(node.target, node.value, node)
+        self.generic_visit(node)
+
+    def _check_fanout_const(self, target, value, node) -> None:
+        if (
+            isinstance(target, ast.Name)
+            and _FANOUT_CONST_RE.match(target.id)
+            and isinstance(value, ast.Constant)
+            and isinstance(value.value, int)
+        ):
+            self.facts.fanout_consts.append(
+                (node.lineno, node.col_offset, target.id, value.value)
+            )
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if node.value == "avg_part":
+            self.facts.mentions_avg_part = True
+        self.generic_visit(node)
+
+    # -- R6 ---------------------------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._add(node, "R6", "bare `except:` hides every failure mode")
+        else:
+            names = []
+            t = node.type
+            for sub in t.elts if isinstance(t, ast.Tuple) else [t]:
+                if isinstance(sub, ast.Name):
+                    names.append(sub.id)
+            if (
+                any(n in ("Exception", "BaseException") for n in names)
+                and len(node.body) == 1
+                and isinstance(node.body[0], ast.Pass)
+            ):
+                self._add(
+                    node, "R6",
+                    "broad exception swallowed (`except "
+                    f"{'/'.join(names)}: pass`) — log it or narrow the type",
+                )
+        self.generic_visit(node)
+
+    # -- await bookkeeping ------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    # -- R7 ---------------------------------------------------------------
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            fn = call.func
+            if isinstance(fn, ast.Name) and fn.id in self.async_funcs:
+                self._add(
+                    call, "R7",
+                    f"coroutine {fn.id}() called without await — it is "
+                    "never scheduled",
+                )
+            elif (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+                and self._class_stack
+                and fn.attr in self.async_methods.get(self._class_stack[-1], ())
+            ):
+                self._add(
+                    call, "R7",
+                    f"coroutine self.{fn.attr}() called without await — it "
+                    "is never scheduled",
+                )
+        self.generic_visit(node)
+
+    # -- calls: R1, R2, R4, R5 -------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func, self.aliases)
+        tail = dotted.split(".")[-1] if dotted else None
+        awaited = id(node) in self._awaited
+
+        # R4 facts: pool constructions in held-reply modules
+        if tail in ("PoolRegistry", "ConnectionPool"):
+            has_req = any(
+                kw.arg == "require_v2"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            self.facts.pool_ctor_calls.append(
+                (node.lineno, node.col_offset, tail, has_req)
+            )
+
+        # R5: meta dict literals with non-string keys
+        meta_arg = None
+        if tail in _META_CALLS:
+            pos = _META_CALLS[tail]
+            if len(node.args) > pos:
+                meta_arg = node.args[pos]
+            for kw in node.keywords:
+                if kw.arg == "meta":
+                    meta_arg = kw.value
+        elif tail in ("dumps", "packb") and dotted and (
+            dotted.endswith("MSGPackSerializer.dumps")
+            or dotted.endswith("msgpack.packb")
+        ):
+            if node.args:
+                meta_arg = node.args[0]
+        if meta_arg is not None:
+            self._check_msgpack_keys(meta_arg)
+
+        if self._in_async() and not awaited:
+            # R2: blocking waits on the loop
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "result":
+                self._add(
+                    node, "R2",
+                    "`.result()` inside an async function blocks the event "
+                    "loop — and self-deadlocks when the future needs THIS "
+                    "loop; await instead",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "run"
+                and "loop"
+                in (dotted or ast.unparse(node.func.value)).lower()
+            ):
+                recv = dotted or f"{ast.unparse(node.func.value)}.run"
+                self._add(
+                    node, "R2",
+                    f"`{recv}(...)` inside an async function blocks this "
+                    "loop on another loop's result — the io_callback "
+                    "self-deadlock shape; await the coroutine or submit()",
+                )
+            # R1: blocking calls
+            elif dotted in _BLOCKING_CALLS or tail in _SERIALIZATION_FUNCS:
+                self._add(
+                    node, "R1",
+                    f"blocking call `{dotted or tail}` inside an async "
+                    "function — move it to a host thread or executor",
+                )
+            elif isinstance(node.func, ast.Name) and node.func.id == "open":
+                self._add(
+                    node, "R1",
+                    "file I/O (`open`) inside an async function — use an "
+                    "executor",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "prepare"
+                and dotted is not None
+                and dotted.endswith("WireTensors.prepare")
+                and node.args
+            ):
+                self._add(
+                    node, "R1",
+                    "WireTensors.prepare(tensors) inside an async function "
+                    "— hot-path payloads must be prepared off-loop "
+                    "(rpc_prepared contract)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "encode"
+                and dotted is not None
+                and dotted.endswith("EncodedBatch.encode")
+            ):
+                self._add(
+                    node, "R1",
+                    "EncodedBatch.encode inside an async function — "
+                    "quantize is O(bytes) work, encode off-loop",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+                and not any(kw.arg in ("timeout", "blocking") for kw in node.keywords)
+                and not node.args
+            ):
+                self._add(
+                    node, "R1",
+                    "un-awaited `.acquire()` without a timeout inside an "
+                    "async function — a threading lock here parks the loop",
+                )
+
+        # R2 (anywhere): run_coroutine_threadsafe(...).result() chain
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "result"
+            and isinstance(node.func.value, ast.Call)
+        ):
+            inner = _dotted(node.func.value.func, self.aliases)
+            if inner and inner.endswith("run_coroutine_threadsafe"):
+                self._add(
+                    node, "R2",
+                    "run_coroutine_threadsafe(...).result() — guaranteed "
+                    "self-deadlock when called on the target loop's own "
+                    "thread; use BackgroundLoop.run (it carries the "
+                    "thread-identity guard)",
+                )
+        self.generic_visit(node)
+
+    def _check_msgpack_keys(self, node: ast.AST) -> None:
+        if not isinstance(node, ast.Dict):
+            return
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and not isinstance(k.value, str):
+                self._add(
+                    k, "R5",
+                    f"msgpack meta key {k.value!r} is "
+                    f"{type(k.value).__name__}, not str — stats/meta maps "
+                    "must use string keys (PR 1 contract)",
+                )
+            if isinstance(v, ast.Dict):
+                self._check_msgpack_keys(v)
+
+
+def _iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                out.extend(
+                    os.path.join(root, f) for f in files if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(out)
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """Lint files/directories; returns ALL findings with ``suppressed``
+    set for baselined ones.  Cross-module rules (R3, R4) are evaluated
+    over the whole linted set, so lint the package root for the real
+    verdict."""
+    findings: list[Finding] = []
+    all_facts: list[tuple[str, _ModuleFacts]] = []
+    suppress_by_path: dict[str, dict[int, set]] = {}
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(
+                Finding(path, getattr(e, "lineno", 0) or 0, 0, "PARSE",
+                        f"could not parse: {e}")
+            )
+            continue
+        suppress_by_path[path] = _suppressions(source)
+        # pre-pass: async def names must exist before visiting call sites.
+        # Scoped precisely — MODULE-LEVEL async defs only for bare-name
+        # calls, and per-class direct methods for self.<m>() calls — so a
+        # sync module function sharing a name with some class's coroutine
+        # is never false-flagged (R7 findings fail the gate; precision
+        # beats recall here)
+        visitor = _Visitor(path)
+        for node in tree.body:
+            if isinstance(node, ast.AsyncFunctionDef):
+                visitor.async_funcs.add(node.name)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.AsyncFunctionDef):
+                        visitor.async_methods.setdefault(
+                            node.name, set()
+                        ).add(sub.name)
+        visitor.visit(tree)
+        findings.extend(visitor.findings)
+        all_facts.append((path, visitor.facts))
+
+    # R3: every fan-out constant must sit below every max_inflight default
+    inflight = [
+        (path, line, val)
+        for path, facts in all_facts
+        for line, val in facts.inflight_defaults
+    ]
+    if inflight:
+        limit = min(v for _, _, v in inflight)
+        where = next((f"{p}:{ln}" for p, ln, v in inflight if v == limit), "?")
+        for path, facts in all_facts:
+            for line, col, name, val in facts.fanout_consts:
+                if val >= limit:
+                    findings.append(
+                        Finding(
+                            path, line, col, "R3",
+                            f"{name}={val} must be < the mux in-flight "
+                            f"limit {limit} ({where}): held replies need "
+                            "every chunk RPC admitted concurrently",
+                        )
+                    )
+
+    # R4: held-reply modules must pin require_v2=True on their pools
+    for path, facts in all_facts:
+        if not facts.mentions_avg_part:
+            continue
+        for line, col, name, has_req in facts.pool_ctor_calls:
+            if not has_req:
+                findings.append(
+                    Finding(
+                        path, line, col, "R4",
+                        f"{name}(...) in a held-reply (avg_part) module "
+                        "without require_v2=True — held replies starve "
+                        "v1's one-RPC-per-socket pool",
+                    )
+                )
+
+    # apply suppressions
+    for f in findings:
+        rules = suppress_by_path.get(f.path, {}).get(f.line, set())
+        if f.rule in rules:
+            f.suppressed = True
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def format_findings(findings: list[Finding], show_suppressed: bool = False) -> str:
+    lines = [
+        f.render()
+        for f in findings
+        if show_suppressed or not f.suppressed
+    ]
+    active = sum(1 for f in findings if not f.suppressed)
+    sup = len(findings) - active
+    lines.append(
+        f"lah-lint: {active} finding(s), {sup} suppressed"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,10 @@
+"""R1 corpus: blocking calls inside async defs (must fire)."""
+import time
+from learning_at_home_tpu.utils.serialization import WireTensors, pack_message
+
+
+async def handler(payload):
+    time.sleep(0.1)  # blocking sleep on the loop
+    data = open("/tmp/x").read()  # file I/O on the loop
+    wire = WireTensors.prepare([payload])  # payload prepare on the loop
+    return pack_message("r", [payload]), data, wire

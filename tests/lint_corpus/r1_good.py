@@ -1,0 +1,17 @@
+"""R1 corpus: the same work, legally placed (must be clean)."""
+import asyncio
+import time
+from learning_at_home_tpu.utils.serialization import WireTensors, pack_message
+
+
+def host_side(payload):
+    time.sleep(0.1)  # sync function: not loop-hosted
+    return WireTensors.prepare([payload]), pack_message("r", [payload])
+
+
+async def handler(lock):
+    await asyncio.sleep(0.1)  # async sleep yields the loop
+    empty = WireTensors.prepare()  # zero-arg prepare: no payload walk
+    async with lock:
+        pass
+    return empty

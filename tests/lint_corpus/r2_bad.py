@@ -1,0 +1,14 @@
+"""R2 corpus: blocking future waits that can self-deadlock (fire)."""
+import asyncio
+
+
+async def waits_on_future(fut):
+    return fut.result()  # blocks the loop; await it instead
+
+
+async def bridges_to_other_loop(client_loop, coro):
+    return client_loop().run(coro)  # loop blocking on a loop
+
+
+def chains_threadsafe(coro, loop):
+    return asyncio.run_coroutine_threadsafe(coro, loop).result()

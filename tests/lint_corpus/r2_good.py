@@ -1,0 +1,11 @@
+"""R2 corpus: the legal shapes (must be clean)."""
+import asyncio
+
+
+async def awaits_future(fut):
+    return await fut
+
+
+def host_submit(coro, loop):
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+    return fut  # caller decides where to wait (BackgroundLoop.run guards)

@@ -1,0 +1,8 @@
+"""R3 corpus: fan-out constant >= mux in-flight limit (must fire)."""
+MAX_CHUNKS_PER_PART = 80  # held replies: needs to sit BELOW max_inflight
+
+
+class Pool:
+    def __init__(self, endpoint, max_inflight: int = 64):
+        self.endpoint = endpoint
+        self.max_inflight = max_inflight

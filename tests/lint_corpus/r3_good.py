@@ -1,0 +1,8 @@
+"""R3 corpus: constant safely below the limit (must be clean)."""
+MAX_CHUNKS_PER_PART = 48
+
+
+class Pool:
+    def __init__(self, endpoint, max_inflight: int = 64):
+        self.endpoint = endpoint
+        self.max_inflight = max_inflight

@@ -1,0 +1,9 @@
+"""R4 corpus: held-reply module without require_v2 (must fire)."""
+from learning_at_home_tpu.utils.connection import PoolRegistry
+
+
+class Averager:
+    PART_MSG = "avg_part"  # held-reply protocol marker
+
+    def __init__(self):
+        self.registry = PoolRegistry()  # held replies starve v1 pools

@@ -1,0 +1,9 @@
+"""R4 corpus: held-reply module pinning v2 (must be clean)."""
+from learning_at_home_tpu.utils.connection import PoolRegistry
+
+
+class Averager:
+    PART_MSG = "avg_part"
+
+    def __init__(self):
+        self.registry = PoolRegistry(require_v2=True)

@@ -1,0 +1,14 @@
+"""R5 corpus: non-string msgpack meta keys (must fire)."""
+import msgpack
+
+from learning_at_home_tpu.utils.serialization import pack_message
+
+
+def stats_reply(bucket, count):
+    # int bucket keys round-trip through msgpack but broke the stats
+    # consumers once already (PR 1)
+    return pack_message("stats", [], {64: count, "nested": {128: 1}})
+
+
+def raw_pack():
+    return msgpack.packb({1: "one"})

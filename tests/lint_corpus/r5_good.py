@@ -1,0 +1,12 @@
+"""R5 corpus: string keys everywhere (must be clean)."""
+import msgpack
+
+from learning_at_home_tpu.utils.serialization import pack_message
+
+
+def stats_reply(bucket, count):
+    return pack_message("stats", [], {str(bucket): count})
+
+
+def raw_pack():
+    return msgpack.packb({"one": 1})

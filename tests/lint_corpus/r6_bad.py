@@ -1,0 +1,15 @@
+"""R6 corpus: bare/swallowed excepts (must fire)."""
+
+
+def swallow_everything(fn):
+    try:
+        fn()
+    except:  # noqa: E722
+        print("oops")
+
+
+def swallow_silently(fn):
+    try:
+        fn()
+    except Exception:
+        pass

@@ -1,0 +1,18 @@
+"""R6 corpus: narrow or logged handlers (must be clean)."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def narrow(fn):
+    try:
+        fn()
+    except (OSError, RuntimeError):
+        pass  # narrow types: a deliberate, scoped ignore
+
+
+def logged(fn):
+    try:
+        fn()
+    except Exception:
+        logger.exception("fn failed")

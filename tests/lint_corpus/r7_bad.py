@@ -1,0 +1,17 @@
+"""R7 corpus: coroutines called but never awaited (must fire)."""
+
+
+async def refresh():
+    return 1
+
+
+class Node:
+    async def heartbeat(self):
+        return 2
+
+    def tick(self):
+        self.heartbeat()  # never scheduled
+
+
+def main():
+    refresh()  # never scheduled

@@ -1,0 +1,32 @@
+"""R7 corpus: coroutines properly scheduled (must be clean)."""
+import asyncio
+
+
+async def refresh():
+    return 1
+
+
+class Node:
+    async def heartbeat(self):
+        return 2
+
+    async def tick(self):
+        await self.heartbeat()
+
+
+async def main():
+    task = asyncio.ensure_future(refresh())
+    await task
+
+
+class Watcher:
+    async def poll(self):
+        return 3
+
+
+def poll():
+    return "sync module-level poll, unrelated to Watcher.poll"
+
+
+def caller():
+    poll()  # sync call: a class's same-named coroutine must not flag this

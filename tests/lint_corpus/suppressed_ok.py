@@ -1,0 +1,13 @@
+"""Suppression corpus: a real finding, baselined (must exit clean)."""
+import time
+
+
+async def slow_probe():
+    # deliberate: one-shot startup probe on a private loop, nothing else
+    # is scheduled yet
+    time.sleep(0.01)  # lah-lint: ignore[R1]
+
+    # standalone-comment form, with explanation lines after the marker:
+    # lah-lint: ignore[R1] startup-only, loop serves nothing yet
+    # (the annotation covers the next code line, through this comment)
+    time.sleep(0.01)

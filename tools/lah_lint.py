@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""lah-lint CLI: check the package against the concurrency/wire rules.
+
+    python tools/lah_lint.py [paths...] [--list-suppressed] [--json]
+
+Default path is ``learning_at_home_tpu/``.  Exit codes: 0 = clean (all
+findings baselined with ``# lah-lint: ignore[Rn]`` annotations or none
+at all), 1 = unsuppressed findings, 2 = parse failure in a linted file.
+
+Rules (R1-R7) and the suppression contract are documented in
+``learning_at_home_tpu/analysis/lint.py`` and docs/CONCURRENCY.md.
+Runs pure-AST — no jax import, sub-second — so it sits in front of the
+collect gate (tools/collect_gate.py --lint).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "paths", nargs="*", default=[os.path.join(REPO, "learning_at_home_tpu")],
+        help="files or directories to lint (default: the package)",
+    )
+    parser.add_argument(
+        "--list-suppressed", action="store_true",
+        help="also print baselined (suppressed) findings",
+    )
+    parser.add_argument("--json", action="store_true", help="machine output")
+    args = parser.parse_args(argv)
+
+    from learning_at_home_tpu.analysis.lint import format_findings, lint_paths
+
+    findings = lint_paths(args.paths)
+    active = [f for f in findings if not f.suppressed]
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        print(format_findings(findings, show_suppressed=args.list_suppressed))
+    if any(f.rule == "PARSE" for f in findings):
+        return 2
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
