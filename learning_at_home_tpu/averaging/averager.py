@@ -59,6 +59,7 @@ from learning_at_home_tpu.averaging.partitioning import (
     unflatten_tree,
     weighted_mean,
 )
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
 from learning_at_home_tpu.utils.connection import (
     QUORUM_STRAGGLER_CANCEL,
@@ -341,7 +342,7 @@ class DecentralizedAverager:
         self._round_active = False
         self._reductions: dict[str, _Reduction] = {}
         # host-side stats (guarded: read by telemetry threads)
-        self._stats_lock = threading.Lock()
+        self._stats_lock = sanitizer.lock("averaging.stats")
         self._rounds = 0
         self._degraded_rounds = 0
         self._failed_parts = 0
@@ -407,37 +408,8 @@ class DecentralizedAverager:
             # parts and our partition, and gets neither
             return None, {"died_after_match": True, "gid": group.gid}
         vec, treedef, specs = flatten_tree(tree)
-        # pack-once, OFF the loop: every chunk's WireTensors — including
-        # any 8-bit quantize (cfg.wire_codec) — is prepared here on the
-        # host thread; the loop only writes ready buffers.  The raw f32
-        # slice view rides along so a peer that turns out not to speak
-        # the codec feature gets the uncompressed chunk instead (the
-        # fallback re-prepares specs only, never re-encodes bytes).
-        from learning_at_home_tpu.utils.serialization import (
-            encode_wire_tensors,
-        )
-
         bounds = partition_bounds(vec.size, len(group.members))
-        sends = []
-        for idx, (pid, mhost, mport, _w) in enumerate(group.members):
-            if pid == self.peer_id:
-                continue
-            lo, hi = bounds[idx]
-            # widen chunks so a partition never exceeds the held-reply
-            # in-flight budget (see MAX_CHUNKS_PER_PART)
-            chunk_elems = max(
-                self.cfg.chunk_elems, -((hi - lo) // -MAX_CHUNKS_PER_PART)
-            )
-            chunks = []
-            for off, n in chunk_ranges(hi - lo, chunk_elems):
-                raw = vec[lo + off : lo + off + n]
-                w_tensors, wmeta = encode_wire_tensors(
-                    [raw], self._wire_codec
-                )
-                chunks.append(
-                    (off, n, WireTensors.prepare(w_tensors), wmeta, raw)
-                )
-            sends.append((idx, pid, (mhost, int(mport)), chunks))
+        sends = self._prepare_sends(group, vec, bounds)
         try:
             result_vec, info = self._run_on_loop(
                 self._reduce_async(group, vec, bounds, sends),
@@ -462,6 +434,42 @@ class DecentralizedAverager:
             timeline.count("averaging.degraded_rounds")
         info.update(epoch=group.epoch, gid=group.gid, round_s=dt)
         return unflatten_tree(result_vec, treedef, specs), info
+
+    @sanitizer.runs_on("host", site="averaging.chunk_prep")
+    def _prepare_sends(self, group: Group, vec: np.ndarray, bounds) -> list:
+        """Pack-once, OFF the loop: every chunk's WireTensors — including
+        any 8-bit quantize (cfg.wire_codec) — is prepared here on the
+        caller's host thread; the lah-avg loop only writes ready buffers
+        (the sanitizer holds this to the same standard as the client's
+        ``_prepare_payloads``).  The raw f32 slice view rides along so a
+        peer that turns out not to speak the codec feature gets the
+        uncompressed chunk instead (the fallback re-prepares specs only,
+        never re-encodes bytes)."""
+        from learning_at_home_tpu.utils.serialization import (
+            encode_wire_tensors,
+        )
+
+        sends = []
+        for idx, (pid, mhost, mport, _w) in enumerate(group.members):
+            if pid == self.peer_id:
+                continue
+            lo, hi = bounds[idx]
+            # widen chunks so a partition never exceeds the held-reply
+            # in-flight budget (see MAX_CHUNKS_PER_PART)
+            chunk_elems = max(
+                self.cfg.chunk_elems, -((hi - lo) // -MAX_CHUNKS_PER_PART)
+            )
+            chunks = []
+            for off, n in chunk_ranges(hi - lo, chunk_elems):
+                raw = vec[lo + off : lo + off + n]
+                w_tensors, wmeta = encode_wire_tensors(
+                    [raw], self._wire_codec
+                )
+                chunks.append(
+                    (off, n, WireTensors.prepare(w_tensors), wmeta, raw)
+                )
+            sends.append((idx, pid, (mhost, int(mport)), chunks))
+        return sends
 
     def _headline_metrics(self) -> dict:
         """Always-on counters exported through the unified metrics
@@ -809,6 +817,8 @@ class DecentralizedAverager:
                 if task in done and not task.cancelled():
                     exc = task.exception()
                     if exc is None:
+                        # lah-lint: ignore[R2] task is in the done set —
+                        # result() on a finished Task returns immediately
                         part = task.result()
                     else:
                         logger.warning(
@@ -864,6 +874,9 @@ class DecentralizedAverager:
                 if pool.supports("codec"):
                     meta["wire"] = wmeta
                 else:
+                    # lah-lint: ignore[R1] raw-fallback re-prepare: specs only
+                    # over the retained f32 slice VIEW — O(1) spec walk,
+                    # no tensor bytes encoded or copied on the loop
                     use_wire = WireTensors.prepare([raw])
             tensors, _meta = await pool.rpc_prepared(
                 "avg_part", use_wire, meta, timeout=sender_timeout,

@@ -46,6 +46,7 @@ from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.serialization import (
     WireTensors,
     decode_wire_tensors,
@@ -186,7 +187,12 @@ class AveragingPeerHandler:
                     # only the wire was quantized.  Chunks are small
                     # (≤ chunk_elems), so the eager decode here costs
                     # microseconds; validation raises → error reply.
-                    tensors = decode_wire_tensors(tensors, wire, lazy=False)
+                    # Scoped sanitizer pass for exactly that bounded
+                    # decode — any unbounded on-loop decode still trips.
+                    with sanitizer.allowed("LazyDecode.decode"):
+                        tensors = decode_wire_tensors(
+                            tensors, wire, lazy=False
+                        )
                     if isinstance(wire, dict):
                         self.quantized_chunks += 1
                 chunk = await self.averager._on_part(meta, tensors)

@@ -23,6 +23,7 @@ import logging
 import threading
 from typing import Any, Callable, Optional
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.averaging.averager import (
     AveragingFailed,
     DecentralizedAverager,
@@ -45,7 +46,7 @@ class AveragingSession:
         self.every_steps = every_steps
         self.rounds_applied = 0
         self.rounds_failed = 0
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("averaging.session")
         self._round_in_flight = False
         # background mode wiring (attach_trainer)
         self._snapshot_fn: Optional[Callable[[], Any]] = None

@@ -53,6 +53,7 @@ from learning_at_home_tpu.client.rpc import (
     dispatch_wait_watchdog,
     pool_registry,
 )
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.connection import (
     QUORUM_STRAGGLER_CANCEL,
     RemoteCallError,
@@ -187,7 +188,7 @@ class RemoteMixtureOfExperts:
         self.source = source
         self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
         self._sessions: OrderedDict[int, dict] = OrderedDict()
-        self._sessions_lock = threading.Lock()
+        self._sessions_lock = sanitizer.lock("moe.sessions")
         self.max_sessions = max_sessions
         self._grid_offsets = np.concatenate(
             [[0], np.cumsum(self.grid_size)[:-1]]
@@ -561,6 +562,7 @@ class RemoteMixtureOfExperts:
             return {"c": codec, "h": headers}
         return _CODEC_TO_DTYPE.get(codec)  # legacy string, or None for raw
 
+    @sanitizer.runs_on("host", site="moe._prepare_payloads")
     def _prepare_payloads(self, kind: str, uid_jobs: dict,
                           x_full=None, gy_full=None,
                           trace=None) -> tuple[dict, dict]:
@@ -1107,6 +1109,8 @@ class RemoteMixtureOfExperts:
             for task in done:
                 endpoint, uids = pending.pop(task)
                 try:
+                    # lah-lint: ignore[R2] task came out of asyncio.wait's
+                    # done set — result() on a finished Task never blocks
                     group_replies = task.result()
                 except Exception as e:
                     logger.warning(

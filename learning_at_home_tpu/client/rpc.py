@@ -13,6 +13,7 @@ import os
 import threading
 from typing import Optional
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
 from learning_at_home_tpu.utils.connection import PoolRegistry, force_protocol_v1
 
@@ -103,7 +104,7 @@ def ensure_sync_cpu_dispatch() -> None:
 # diagnosable event: one WARNING per process, with every thread's stack.
 # --------------------------------------------------------------------------
 
-_watchdog_lock = threading.Lock()
+_watchdog_lock = sanitizer.lock("client.rpc.watchdog")
 _watchdog_fired = False
 
 

@@ -43,6 +43,8 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 import jax
 import optax
 
+from learning_at_home_tpu.utils import sanitizer
+
 __all__ = ["PipelinedSwarmTrainer"]
 
 
@@ -71,8 +73,8 @@ class PipelinedSwarmTrainer:
         self.params = params
         self.opt_state = opt_state if opt_state is not None else optimizer.init(params)
         self.n_workers = n_workers
-        self._apply_lock = threading.Lock()
-        self._batch_lock = threading.Lock()
+        self._apply_lock = sanitizer.lock("trainer.apply")
+        self._batch_lock = sanitizer.lock("trainer.batch")
         self._grad_fn = jax.value_and_grad(model.loss_fn)
         self.losses: list[float] = []
         self.step_count = 0

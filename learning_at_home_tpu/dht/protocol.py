@@ -133,6 +133,8 @@ class DHTProtocol:
                     break
                 msg_type, _, meta = unpack_message(payload)
                 reply = self._serve(msg_type, meta, peer_host)
+                # lah-lint: ignore[R1] DHT control plane: replies are
+                # small msgpack maps (routing records), never tensor bytes
                 await send_frame(writer, pack_message("r", meta=reply))
         except Exception:
             logger.exception("DHT handler error from %s", peer_host)

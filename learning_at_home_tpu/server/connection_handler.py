@@ -46,6 +46,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
     WIRE_CODECS,
@@ -144,7 +145,14 @@ async def encode_reply_wire(tensors, wire) -> tuple[list, dict | None]:
     nbytes = sum(np.asarray(t).nbytes for t in tensors)
     if nbytes >= ENCODE_OFFLOOP_BYTES:
         return await asyncio.to_thread(encode_wire_tensors, tensors, codec)
-    return encode_wire_tensors(tensors, codec)
+    # deliberate on-loop encode: below ENCODE_OFFLOOP_BYTES the thread
+    # hop costs more than the quantize itself — scoped sanitizer pass,
+    # so any OTHER on-loop encode still trips the check
+    with sanitizer.allowed("EncodedBatch.encode"):
+        # lah-lint: ignore[R1] size-gated: this branch only runs below
+        # ENCODE_OFFLOOP_BYTES, where a thread hop costs more than the
+        # quantize; large replies took the to_thread branch above
+        return encode_wire_tensors(tensors, codec)
 
 
 class ConnectionHandler:
@@ -392,8 +400,12 @@ class ConnectionHandler:
             reply_meta["wire"] = {"c": wire.get("c"), "h": reply_headers}
         if trace is not None:
             reply_meta["trace"] = trace  # echo: the reply joins the trace
+        # reply prepare is an O(#tensors) spec walk over zero-copy
+        # memoryviews — the O(bytes) work (encode/downcast) already ran
+        # off-loop or in the executor above
         return pack_frames(
-            "result", WireTensors.prepare(reply_tensors),
+            "result",
+            WireTensors.prepare(reply_tensors),  # lah-lint: ignore[R1]
             reply_meta, rid=rid,
         )
 

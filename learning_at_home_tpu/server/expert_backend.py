@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from learning_at_home_tpu.utils import sanitizer
+
 logger = logging.getLogger(__name__)
 
 
@@ -105,7 +107,7 @@ class ExpertBackend:
         # old buffers, so a checkpoint snapshot racing an update would read
         # invalidated arrays.  backward runs on the Runtime thread;
         # state_dict may be called from any thread.
-        self._state_lock = threading.Lock()
+        self._state_lock = sanitizer.lock("server.expert_state")
 
         self._jit_forward = jax.jit(self._forward_impl)
         # params/opt_state donated: XLA reuses their HBM for the new state.

@@ -342,8 +342,11 @@ class Server:
             if prev is not None:
                 try:
                     await asyncio.wrap_future(prev)
+                # lah-lint: ignore[R6] ordering barrier only: the prior
+                # request's failure was already logged (and replied) where
+                # it happened; this await exists to sequence replies
                 except BaseException:
-                    pass  # prior request's failure was already logged
+                    pass
             # the pump's C side frames replies itself: join the vectored
             # parts back into one payload (no writev through ctypes)
             reply = frame_payload(await handler._dispatch(payload))

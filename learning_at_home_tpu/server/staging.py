@@ -24,6 +24,8 @@ import threading
 
 import numpy as np
 
+from learning_at_home_tpu.utils import sanitizer
+
 # keep at most this many idle buffers per (shape, dtype) key: double
 # buffering needs 2; a small surplus absorbs pool churn without letting
 # a one-off giant bucket pin host memory forever
@@ -42,7 +44,7 @@ class StagingBuffers:
     def __init__(self, max_free_per_key: int = MAX_FREE_PER_KEY):
         self.max_free_per_key = max_free_per_key
         self._free: dict[tuple, list[np.ndarray]] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("server.staging")
         self.allocated = 0
         self.reused = 0
 

@@ -35,6 +35,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.serialization import LazyDecode
 
 logger = logging.getLogger(__name__)
@@ -83,6 +84,7 @@ class BatchJob:
     # owner and stays unstamped
     traces: list = field(compare=False, default_factory=list)
 
+    @sanitizer.runs_on("runtime", site="BatchJob.stack")
     def stack(self, staging) -> tuple[list, list]:
         """Copy task rows into padded staging buffers (Runtime thread).
 

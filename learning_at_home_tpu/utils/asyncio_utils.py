@@ -142,7 +142,24 @@ class BackgroundLoop:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def run(self, coro: Awaitable, timeout: Optional[float] = None) -> Any:
-        """Schedule a coroutine and block until its result."""
+        """Schedule a coroutine and block until its result.
+
+        Refuses to run from the loop's OWN thread: ``.result()`` there
+        blocks the only thread that could ever resolve the future — the
+        exact self-deadlock shape of the jitted-client ``io_callback``
+        hang (ROUND5 hazards; lint rule R2 catches the static shape,
+        this guard retires the runtime one).  The check is one thread
+        identity comparison, so it is always on, not just under
+        LAH_SANITIZE."""
+        if threading.current_thread() is self.thread:
+            coro.close()  # never-awaited coroutine would warn at GC
+            raise RuntimeError(
+                f"BackgroundLoop.run() called from its own loop thread "
+                f"{self.thread.name!r} — guaranteed self-deadlock (the "
+                "blocked thread IS the loop that must resolve the "
+                "future).  Await the coroutine instead, or hop to a "
+                "host thread."
+            )
         return self.submit(coro).result(timeout)
 
     def shutdown(self) -> None:

@@ -30,6 +30,7 @@ import os
 import threading
 from typing import Optional, Sequence
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.asyncio_utils import asyncio_timeout
 from learning_at_home_tpu.utils.profiling import timeline
 from learning_at_home_tpu.utils.serialization import (
@@ -296,8 +297,13 @@ class ConnectionPool:
         ``timeout`` bounds the WHOLE exchange including connection
         establishment — a black-holed endpoint (dropped SYNs) must not
         stall the caller for the OS connect timeout."""
+        # documented control-plane exception (see docstring): hot-path
+        # callers use rpc_prepared with payloads built off-loop; rpc()
+        # serializes small control frames only
         return await self.rpc_prepared(
-            msg_type, WireTensors.prepare(tensors), meta, timeout
+            msg_type,
+            WireTensors.prepare(tensors),  # lah-lint: ignore[R1]
+            meta, timeout,
         )
 
     async def rpc_prepared(
@@ -537,7 +543,7 @@ class PoolRegistry:
         max_inflight: int = 64,
     ):
         self._pools: dict[Endpoint, ConnectionPool] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("connection.pool_registry")
         self.max_connections = max_connections_per_endpoint
         self.negotiate_v2 = negotiate_v2
         self.require_v2 = require_v2

@@ -44,6 +44,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)
@@ -82,7 +83,7 @@ class _Metric:
         self.name = sanitize_metric_name(name)
         self.help = help
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("metrics.instrument")
         self._values: dict[tuple, Any] = {}
 
     def _child_key(self, labels: dict) -> tuple:
@@ -174,7 +175,7 @@ class MetricsRegistry:
 
     def __init__(self, max_label_sets: int = 64):
         self.max_label_sets = max_label_sets
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("metrics.registry")
         self._metrics: "OrderedDict[str, _Metric]" = OrderedDict()
         self._collectors: "OrderedDict[str, Callable[[], Optional[dict]]]" = (
             OrderedDict()

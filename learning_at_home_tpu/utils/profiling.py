@@ -70,6 +70,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from learning_at_home_tpu.utils import sanitizer
+
 
 def new_trace_id() -> str:
     """A compact (16 hex chars, 64-bit) globally-unlikely-to-collide trace
@@ -104,7 +106,7 @@ class Timeline:
         self.max_counter_keys = int(
             os.environ.get("LAH_TIMELINE_MAX_KEYS", max_counter_keys)
         )
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("profiling.timeline")
         self.enabled = os.environ.get("LAH_PROFILE", "") not in ("", "0")
         # rebase for cross-process merges: monotonic + offset ≈ wall clock
         self._clock_offset = time.time() - time.monotonic()

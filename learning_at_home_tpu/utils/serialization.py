@@ -43,6 +43,8 @@ import numpy as np
 
 import ml_dtypes  # noqa: F401  (registers bfloat16 & friends with numpy)
 
+from learning_at_home_tpu.utils import sanitizer
+
 _U32 = struct.Struct("<I")
 
 # Hard cap on a single frame (1 GiB) — protects against length-prefix
@@ -177,6 +179,10 @@ class WireTensors:
         return cls(specs, blobs)
 
 
+# the device thread must never serialize wire frames: frame packing on
+# lah-runtime would stall the double-buffered stack/dispatch pipeline
+# behind network work (the loops and host threads are the packers)
+@sanitizer.runs_on("not:lah-runtime", site="pack_frames")
 def pack_frames(
     msg_type: str,
     wire: WireTensors,
@@ -547,9 +553,15 @@ class LazyDecode:
                 f"decode_into shape mismatch: out {out.shape} vs "
                 f"wire {self.shape}"
             )
+        # dequantize is O(bytes) work: it belongs to the Runtime thread
+        # (staging path) or a blocked host thread, never an event loop
+        # (the averaging handler's bounded eager decode holds an explicit
+        # sanitizer.allowed() pass — see averaging/handler.py)
+        sanitizer.check("host", "LazyDecode.decode")
         _decode_quant_into(out, self.wire, self.header)
 
     def decode(self) -> np.ndarray:
+        sanitizer.check("host", "LazyDecode.decode")
         out = np.empty(self.shape, np.float32)
         _decode_quant_into(out, self.wire, self.header)
         return out
@@ -583,19 +595,15 @@ class EncodedBatch:
         self._aux = aux
 
     @classmethod
+    @sanitizer.runs_on("host", site="EncodedBatch.encode")
     def encode(cls, arr, codec: str) -> "EncodedBatch":
         validate_wire_codec(codec)
         a = np.asarray(arr)
         if codec == "none" or not is_float_dtype(a.dtype):
             return cls("none", a, None)
         if codec in ("bf16", "f16"):
-            # module-level lookup on purpose: the no-work-on-the-loop
-            # regression tests monkeypatch wire_cast to track the thread
-            # every downcast runs on
-            import learning_at_home_tpu.utils.serialization as _ser
-
             return cls(
-                codec, _ser.wire_cast([a], _CODEC_TO_DTYPE[codec])[0], None
+                codec, wire_cast([a], _CODEC_TO_DTYPE[codec])[0], None
             )
         a32 = np.asarray(a, dtype=np.float32)
         if a32.ndim and not a32.flags["C_CONTIGUOUS"]:
