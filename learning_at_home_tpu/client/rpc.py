@@ -206,8 +206,11 @@ def reset_client_rpc() -> None:
 
                 try:
                     _loop.run(_close(), timeout=5)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # best-effort teardown, but never silent (R6): a close
+                    # that fails repeatedly is an FD leak worth seeing
+                    logger.debug("client pool close failed during reset: "
+                                 "%s: %s", type(e).__name__, e)
         if _loop is not None:
             _loop.shutdown()
             _loop = None
