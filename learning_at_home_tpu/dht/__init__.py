@@ -80,8 +80,11 @@ class DHT:
     def shutdown(self) -> None:
         try:
             self._loop.run(self.node.shutdown(), timeout=5)
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort: the loop is being torn down either way, but a
+            # failed node shutdown should be visible at debug level (R6)
+            logger.debug("DHT node shutdown failed: %s: %s",
+                         type(e).__name__, e)
         self._loop.shutdown()
 
     # ---- loop bridging: async API usable from any thread/loop ----
