@@ -499,5 +499,7 @@ class MetricsHTTPServer:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except (OSError, RuntimeError):
+                # close on an already-dead transport (R6: narrowed from
+                # a blanket Exception swallow)
                 pass
