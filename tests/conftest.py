@@ -15,6 +15,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Concurrency sanitizer (ISSUE 6) ON BY DEFAULT under pytest: every
+# tier-1 dispatch runs with thread-identity assertions, the event-loop
+# stall detector, and lock-order tracking armed.  Must be set before the
+# package is imported (the arming decision is made at import time);
+# LAH_SANITIZE=0 in the environment opts a run out.
+os.environ.setdefault("LAH_SANITIZE", "1")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon register() in subprocesses
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in xla_flags:
@@ -35,3 +41,65 @@ if os.environ.get("LAH_DUMP_STACKS"):
     faulthandler.dump_traceback_later(
         int(os.environ["LAH_DUMP_STACKS"]), repeat=True, exit=False
     )
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_guard():
+    """The shared replacement for the old per-file thread-tracking
+    monkeypatch fixtures (ISSUE 6): every test runs under the sanitizer's
+    thread-identity checks, and any violation it records FAILS the test
+    that caused it.  Seeded-violation tests drain their expected findings
+    through ``sanitizer.expect_violations()`` so this guard stays green.
+    """
+    from learning_at_home_tpu.utils import sanitizer
+
+    if not sanitizer.enabled():
+        yield
+        return
+    before = sanitizer.violation_count()
+    yield
+    new = sanitizer.violations()[before:]
+    if new:
+        rendered = "\n".join(
+            f"  [{v['kind']}] {v['site']} on thread {v['thread']}: "
+            f"{v['detail']}"
+            for v in new
+        )
+        pytest.fail(
+            f"concurrency sanitizer recorded {len(new)} violation(s) "
+            f"during this test:\n{rendered}"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Export the sanitizer roll-up into the gate output: printed on
+    every run (the tier-1 log IS the gate artifact) and written as JSON
+    when LAH_SANITIZE_SUMMARY names a path (tools/collect_gate)."""
+    try:
+        from learning_at_home_tpu.utils import sanitizer
+    except Exception:
+        return
+    if not sanitizer.enabled():
+        return
+    import json
+
+    summary = sanitizer.summary()
+    stall = sanitizer.stall_stats()
+    if stall.get("last"):
+        # the live stack was already logged when the stall fired; the
+        # one-line gate summary keeps only what/how-long
+        stall["last"] = {
+            k: v for k, v in stall["last"].items() if k != "stack"
+        }
+    summary.update(stall=stall)
+    line = json.dumps(summary, sort_keys=True)
+    print(f"\nLAH_SANITIZER_SUMMARY {line}")
+    path = os.environ.get("LAH_SANITIZE_SUMMARY")
+    if path:
+        try:
+            with open(path, "w") as fh:
+                fh.write(line + "\n")
+        except OSError:
+            pass

@@ -163,26 +163,25 @@ def test_send_frame_parts_wire_parity():
 # ---------------------------------------------------------------------------
 
 
-def test_no_serialization_on_client_event_loop(monkeypatch):
+def test_no_serialization_on_client_event_loop():
     """Regression: in pipelined mode, neither the wire downcast nor the
     spec/blob walk may run on the ``lah-client`` loop thread — payloads
-    arrive at the loop pre-serialized."""
+    arrive at the loop pre-serialized.
+
+    The old version monkeypatched ``ser.wire_cast``/``_tensor_to_wire``
+    to track thread names; the invariant now lives in the sanitizer's
+    ``@runs_on("host")`` assertions on ``moe._prepare_payloads`` and
+    ``EncodedBatch.encode`` — any on-loop serialization is a recorded
+    violation the shared conftest guard turns into a failure, and the
+    site stats prove the host thread really did the packing."""
     import jax
     import jax.numpy as jnp
 
-    cast_threads, wire_threads = [], []
-    real_cast, real_ttw = ser.wire_cast, ser._tensor_to_wire
+    from learning_at_home_tpu.utils import sanitizer
 
-    def tracking_cast(tensors, wd):
-        cast_threads.append(threading.current_thread().name)
-        return real_cast(tensors, wd)
-
-    def tracking_ttw(arr):
-        wire_threads.append(threading.current_thread().name)
-        return real_ttw(arr)
-
-    monkeypatch.setattr(ser, "wire_cast", tracking_cast)
-    monkeypatch.setattr(ser, "_tensor_to_wire", tracking_ttw)
+    if not sanitizer.enabled():
+        pytest.skip("sanitizer disabled (LAH_SANITIZE=0)")
+    before = sanitizer.site_stats()
 
     with background_server(
         num_experts=4, hidden_dim=HID, expert_prefix="ffn", seed=0
@@ -201,14 +200,20 @@ def test_no_serialization_on_client_event_loop(monkeypatch):
             return jnp.sum(moe(x, g) ** 2)
 
         jax.grad(loss)(gate, x)  # forward + backward fan-out
-        client_threads = {
-            t for t in cast_threads + wire_threads if t.startswith("lah-client")
-        }
-        assert not client_threads, (
-            f"serialization ran on the client event loop: {client_threads}"
-        )
-        # …and it really ran somewhere (the host callback thread)
-        assert cast_threads and wire_threads
+        after = sanitizer.site_stats()
+
+        def delta(site, cls):
+            return after.get(site, {}).get(cls, 0) - before.get(
+                site, {}
+            ).get(cls, 0)
+
+        # the pack really ran, and on a host thread (the io_callback
+        # thread is unnamed → class "host"; the lah-client loop would
+        # class as "lah-client" AND record a violation)
+        assert delta("moe._prepare_payloads", "host") >= 2  # fwd + bwd
+        assert delta("EncodedBatch.encode", "host") > 0
+        assert delta("moe._prepare_payloads", "lah-client") == 0
+        assert delta("EncodedBatch.encode", "lah-client") == 0
         assert moe.pack_bytes > 0
         assert moe.pack_bytes_saved > 0  # k=2 shares one downcast
         assert len(moe.pack_times) >= 2 and len(moe.wait_times) >= 2

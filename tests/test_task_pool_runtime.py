@@ -1,7 +1,6 @@
 """Tests for the cross-request batcher + single-consumer device loop (M0)."""
 
 import asyncio
-import threading
 
 import numpy as np
 import pytest
@@ -175,19 +174,19 @@ def test_batcher_property_randomized():
         run_pool(main())
 
 
-def test_no_stacking_on_event_loop(monkeypatch):
+def test_no_stacking_on_event_loop():
     """Regression for the pipelined hot path: per-batch stacking must not
-    run on the asyncio loop thread — no np.concatenate there, and the
-    staging copies happen on the Runtime thread."""
+    run on the asyncio loop thread.  The old version monkeypatched
+    ``np.concatenate`` to track threads; now the sanitizer's first-class
+    ``@runs_on("runtime")`` assertion on ``BatchJob.stack`` carries the
+    invariant — the shared conftest guard fails this test on any
+    violation, and the site stats prove the Runtime thread really did
+    the stacking."""
+    from learning_at_home_tpu.utils import sanitizer
 
-    concat_threads = []
-    real_concatenate = np.concatenate
-
-    def tracking_concatenate(*args, **kwargs):
-        concat_threads.append(threading.current_thread().name)
-        return real_concatenate(*args, **kwargs)
-
-    monkeypatch.setattr(np, "concatenate", tracking_concatenate)
+    if not sanitizer.enabled():
+        pytest.skip("sanitizer disabled (LAH_SANITIZE=0)")
+    before = sanitizer.site_stats().get("BatchJob.stack", {})
 
     async def main():
         def process(inputs):
@@ -198,20 +197,28 @@ def test_no_stacking_on_event_loop(monkeypatch):
         runtime.attach_loop(asyncio.get_running_loop())
         runtime.start()
         pool.start(runtime)
-        loop_thread = threading.current_thread().name
         xs = [np.full((3, 2), i, np.float32) for i in range(4)]
         outs = await asyncio.gather(*(pool.submit_task(x) for x in xs))
         runtime.shutdown()
         for i, (out,) in enumerate(outs):
             np.testing.assert_array_equal(out, xs[i] * 2)
-        assert loop_thread not in concat_threads, (
-            "batch stacking ran on the event loop thread"
-        )
         # the copies really happened runtime-side, into staging buffers
         assert runtime.stack_time >= 0.0
         assert runtime.staging.allocated >= 1
 
     run_pool(main())
+    after = sanitizer.site_stats().get("BatchJob.stack", {})
+    ran_on_runtime = after.get("runtime", 0) - before.get("runtime", 0)
+    assert ran_on_runtime > 0, (
+        f"BatchJob.stack never ran on the lah-runtime thread: {after}"
+    )
+    # loop-thread stacking would ALSO have tripped the conftest guard;
+    # assert the observable here too so the failure reads locally
+    for cls in after:
+        if cls != "runtime" and after.get(cls, 0) > before.get(cls, 0):
+            raise AssertionError(
+                f"BatchJob.stack ran on a {cls!r} thread during this test"
+            )
 
 
 def test_staging_buffer_reuse_and_isolation():
