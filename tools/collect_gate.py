@@ -3,22 +3,30 @@
 imports (e.g. a jax API moved between releases, like the ``jax.shard_map``
 regression) instead of surfacing as tier-1 collection errors minutes in.
 
-Runs ``pytest --collect-only`` on CPU and exits non-zero on any collection
-error, then a CLIENT-PATH SMOKE: one forward+backward RPC against a local
-server under BOTH wire protocols (legacy/v1 and pipelined/v2), so
-wire-format breakage fails here in seconds instead of ten minutes into
-the tier-1 run, then an AVERAGING SMOKE: two in-process trainer-side
-averaging peers complete one DHT-matched all-reduce round and must end
-with identical parameters (``averaging_stats()["rounds"] == 1``), then a
-TELEMETRY SMOKE (ISSUE 4): one DHT-joined server must expose the
-always-on headline metrics on its Prometheus endpoint and be rendered by
-``lah_top --once`` via DHT discovery alone.  Wire it before the full
-suite:
+Stage 0 is the LINT GATE (ISSUE 6): ``lah_lint`` runs over the package
+(pure AST, sub-second) and any non-baselined R1-R7 finding fails the
+gate before a single test collects.  Then ``pytest --collect-only`` on
+CPU exits non-zero on any collection error, then a CLIENT-PATH SMOKE:
+one forward+backward RPC against a local server under BOTH wire
+protocols (legacy/v1 and pipelined/v2), so wire-format breakage fails
+here in seconds instead of ten minutes into the tier-1 run, then an
+AVERAGING SMOKE: two in-process trainer-side averaging peers complete
+one DHT-matched all-reduce round and must end with identical parameters
+(``averaging_stats()["rounds"] == 1``), then a TELEMETRY SMOKE (ISSUE
+4): one DHT-joined server must expose the always-on headline metrics on
+its Prometheus endpoint and be rendered by ``lah_top --once`` via DHT
+discovery alone.  Wire it before the full suite:
 
     python tools/collect_gate.py && pytest tests/ ...
 
-``--no-smoke`` skips the RPC smoke; ``--smoke-worker`` is the internal
-child mode that actually runs it.
+The tier-1 pytest run itself executes under the concurrency sanitizer
+(tests/conftest arms LAH_SANITIZE=1) and prints a
+``LAH_SANITIZER_SUMMARY`` roll-up (stall count, max stall ms, lock-graph
+edge count) at session end; set ``LAH_SANITIZE_SUMMARY=<path>`` to also
+export it as JSON, which this gate prints when present.
+
+``--lint`` runs ONLY the lint stage; ``--no-smoke`` skips the RPC smoke;
+``--smoke-worker`` is the internal child mode that actually runs it.
 """
 
 import os
@@ -56,6 +64,40 @@ def orphan_guard() -> int:
     print("collect_gate: REFUSING — kill the orphan PIDs above (kill -9 "
           "<pid>) or set LAH_IGNORE_ORPHANS=1", file=sys.stderr)
     return 4
+
+
+def lint_stage() -> int:
+    """Stage 0: ``lah_lint`` over the package.  Fails (rc=5) on any
+    finding not baselined with an inline ``# lah-lint: ignore[Rn]``
+    annotation — new concurrency-invariant violations never reach the
+    test stages.  Pure AST: no jax import, sub-second."""
+    sys.path.insert(0, REPO)
+    try:
+        from learning_at_home_tpu.analysis.lint import (
+            format_findings,
+            lint_paths,
+        )
+    except Exception as e:
+        print(f"collect_gate: lint stage unavailable ({e})", file=sys.stderr)
+        return 5
+    findings = lint_paths([os.path.join(REPO, "learning_at_home_tpu")])
+    active = [f for f in findings if not f.suppressed]
+    if active:
+        print("collect_gate: FAIL — lint findings (fix them or baseline "
+              "with `# lah-lint: ignore[Rn] <reason>`):", file=sys.stderr)
+        print(format_findings(findings), file=sys.stderr)
+        return 5
+    sup = sum(1 for f in findings if f.suppressed)
+    print(f"collect_gate: lint OK — 0 findings, {sup} baselined")
+    # surface the most recent tier-1 sanitizer export, if one exists
+    summary_path = os.environ.get("LAH_SANITIZE_SUMMARY")
+    if summary_path and os.path.exists(summary_path):
+        try:
+            with open(summary_path) as fh:
+                print(f"collect_gate: sanitizer summary — {fh.read().strip()}")
+        except OSError:
+            pass
+    return 0
 
 
 def smoke_worker() -> int:
@@ -331,6 +373,11 @@ def run_smoke() -> int:
 
 
 def main() -> int:
+    rc = lint_stage()  # stage 0: static invariants, cheapest first
+    if rc:
+        return rc
+    if "--lint" in sys.argv:
+        return 0
     rc = orphan_guard()  # BEFORE any timing work (smokes spawn servers)
     if rc:
         return rc
