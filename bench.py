@@ -181,7 +181,7 @@ def run_dispatch_microbench(deadline: int = 600) -> dict | None:
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "490a01e"
+PREV_ROUND_REV = "b21994a"
 
 
 def check_orphan_servers() -> dict | None:
@@ -353,6 +353,11 @@ def main() -> int:
         avg = run_averaging_microbench()
         if avg:
             result.update(avg)
+        # overlapped-vs-serial swarm step A/B (ISSUE 7): chaos-latency
+        # regime must show overlap; loopback regime must be in the noise
+        ovl = run_overlap_bench()
+        if ovl:
+            result.update(ovl)
     if box_dirty:
         result.update(box_dirty)
     print(json.dumps(result), flush=True)
@@ -1200,6 +1205,136 @@ def _codec_chaos_ab(measure, make_moe_l_kwargs: dict) -> dict:
     return out
 
 
+def overlap_worker() -> None:
+    """Overlapped-vs-serial swarm step A/B (ISSUE 7 acceptance): a
+    2-layer swarm against per-pool injected latency (chaos proxy), plus
+    a no-delay loopback control.
+
+    Same-session interleaved pairs per BASELINE.md: the two schedules
+    run the SAME primitive ops against identically-configured pools, so
+    the per-step p50 ratio isolates the scheduling change.  Chaos
+    regime: overlapped must be strictly faster with overlap_fraction
+    > 0.3 under ~50 ms RTT.  Loopback regime: nothing to hide — the
+    ratio must sit in the noise band (the fire/join split costs ~zero).
+    Forward-only steps: the backward schedule is the same machinery run
+    in reverse (join-bwd fires, fire-bwd joins — tier-1 parity tests
+    cover it); an eager op-by-op backward at this row count measures
+    XLA eager overhead, not dispatch."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ.get("BENCH_DEADLINE_S", "420")), exit=True
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmDMoETransformerLM,
+    )
+    from learning_at_home_tpu.utils.subproc import (
+        shutdown_procs,
+        spawn_overlap_swarm,
+    )
+
+    d_model, seq, batch = 512, 64, 8
+    pairs = int(os.environ.get("BENCH_OVERLAP_PAIRS", "4"))
+    out: dict = {}
+
+    def regime(label: str, latencies) -> dict:
+        # nop experts + subprocess isolation: see spawn_expert_servers —
+        # the in-flight window must be pure latency, on its own GIL
+        procs, source, cfg = spawn_overlap_swarm(
+            REPO, "ovb", latencies, d_model=d_model, seq=seq
+        )
+        try:
+            # one model per arm: overlap fractions must not mix schedules
+            models = {
+                "serial": SwarmDMoETransformerLM(cfg, source),
+                "overlapped": SwarmDMoETransformerLM(cfg, source),
+            }
+            params = models["serial"].init_params(jax.random.PRNGKey(0))
+            ids = jnp.asarray(
+                np.random.RandomState(0).randint(0, 64, (batch, seq))
+            )
+
+            def step(arm: str) -> float:
+                t0 = time.monotonic()
+                jax.block_until_ready(
+                    models[arm].apply_overlapped(
+                        params, ids, overlap=(arm == "overlapped")
+                    )
+                )
+                return time.monotonic() - t0
+
+            for arm in models:  # compile + connection warmup, unmeasured
+                step(arm)
+            times: dict[str, list] = {"serial": [], "overlapped": []}
+            for _ in range(pairs):
+                for arm in ("serial", "overlapped"):
+                    times[arm].append(step(arm))
+            s50 = float(np.median(times["serial"])) * 1e3
+            o50 = float(np.median(times["overlapped"])) * 1e3
+            frac = max(
+                m.dispatch_stats()["overlap_fraction"]
+                for m in models["overlapped"].moes
+            )
+            return {
+                f"overlap_{label}_step_p50_ms_serial": round(s50, 2),
+                f"overlap_{label}_step_p50_ms_overlapped": round(o50, 2),
+                f"overlap_{label}_vs_serial": (
+                    round(o50 / s50, 3) if s50 else None
+                ),
+                f"overlap_{label}_fraction": round(frac, 3),
+            }
+        finally:
+            shutdown_procs(procs)
+            reset_client_rpc()
+
+    out["overlap_rows"] = batch * seq
+    out["overlap_ab_pairs"] = pairs
+    out["overlap_chaos_latency_s"] = [0.05, 0.06]
+    out.update(regime("chaos", (0.05, 0.06)))
+    # partial print first: a loopback-regime failure must never forfeit
+    # the chaos numbers (the acceptance observable)
+    print(json.dumps(out), flush=True)
+    out.update(regime("loopback", (0.0, 0.0)))
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(out), flush=True)
+
+
+def run_overlap_bench(deadline: int = 420) -> dict | None:
+    """Overlapped-vs-serial A/B in a scrubbed CPU subprocess (host/DCN
+    tier, accelerator-independent like the dispatch microbench)."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_DEADLINE_S"] = str(deadline)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--overlap-worker"],
+            capture_output=True, text=True, timeout=deadline + 30,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        print("bench: overlap bench timed out", file=sys.stderr)
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        r = None
+    else:
+        stdout = r.stdout
+    result = _last_json_line(stdout)
+    if result is not None:
+        return result
+    if r is not None:
+        print(f"bench: overlap bench rc={r.returncode}, no JSON\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+    return None
+
+
 def averaging_worker() -> None:
     """Trainer-side averaging microbench: two in-process peers run
     ``--avg-rounds`` DHT-matched all-reduce rounds over a trunk-sized
@@ -1300,5 +1435,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--averaging-worker" in sys.argv:
         averaging_worker()
+        sys.exit(0)
+    if "--overlap-worker" in sys.argv:
+        overlap_worker()
         sys.exit(0)
     sys.exit(main())

@@ -48,9 +48,9 @@ from learning_at_home_tpu.client.routing import (
     select_top_k,
 )
 from learning_at_home_tpu.client.rpc import (
+    DispatchFuture,
     client_loop,
     dispatch_mode,
-    dispatch_wait_watchdog,
     pool_registry,
 )
 from learning_at_home_tpu.utils import sanitizer
@@ -194,6 +194,17 @@ class RemoteMixtureOfExperts:
             [[0], np.cumsum(self.grid_size)[:-1]]
         ).astype(np.int32)
         self._dispatch = self._build_dispatch()
+        # future-based dispatch (ISSUE 7): tickets for fired-but-unjoined
+        # fan-outs, keyed by the handle the fire op returned.  Bounded
+        # like _sessions — an evicted ticket cancels its fan-out.
+        self._pending: OrderedDict[int, DispatchFuture] = OrderedDict()
+        self._pending_bwd: OrderedDict[int, DispatchFuture] = OrderedDict()
+        self._fire_op, self._join_op = self._build_async_ops()
+        # overlap telemetry: time-weighted accumulators behind
+        # lah_client_overlap_fraction (0 in the serial regime)
+        self.inflight_seconds = 0.0
+        self.join_blocked_seconds = 0.0
+        self.inflight_dispatches = 0  # gauge: fired, not yet joined
         # dispatch latency telemetry (north-star: dispatch p50); bounded so
         # long runs don't grow memory
         self.dispatch_times: deque[float] = deque(maxlen=10_000)
@@ -259,6 +270,13 @@ class RemoteMixtureOfExperts:
         logits = [x @ gate_params[f"w{d}"] for d in range(self.n_dims)]
         logits_concat = jnp.concatenate(logits, axis=-1)  # [B, sum(grid)]
         y, idx, mask = self._dispatch(x, logits_concat)
+        return self._combine(y, idx, mask, logits_concat)
+
+    def _combine(self, y, idx, mask, logits_concat):
+        """Gate-weighted mixture of the dispatch replies — the in-graph,
+        differentiable second half shared by :meth:`__call__` and the
+        fire/join path (identical ops, so the two paths stay bitwise
+        comparable)."""
         # gather each chosen expert's score from the (differentiable) logits
         scores = jnp.zeros(mask.shape, logits_concat.dtype)
         for d in range(self.n_dims):
@@ -272,6 +290,30 @@ class RemoteMixtureOfExperts:
         weights = jax.nn.softmax(scores, axis=-1)
         weights = jnp.where(mask, weights, 0.0)
         return jnp.einsum("bk,bkd->bd", weights.astype(y.dtype), y)
+
+    # ---- fire/join: the overlapped two-phase form of __call__ ----
+
+    def fire(self, x, gate_params: dict):
+        """Phase one of an overlapped dispatch: in-graph gating, then the
+        fire op — selection + payload serialization on the host thread
+        and a NON-BLOCKING fan-out submit to the client loop.  Returns
+        ``(token, handle, logits_concat)`` for :meth:`join`; everything
+        the caller computes between fire and join overlaps the in-flight
+        expert RPCs (the ScMoE-style scheduling the overlapped swarm
+        step exploits — models/transformer_swarm.py)."""
+        logits = [x @ gate_params[f"w{d}"] for d in range(self.n_dims)]
+        logits_concat = jnp.concatenate(logits, axis=-1)
+        token, handle = self._fire_op(x, logits_concat)
+        return token, handle, logits_concat
+
+    def join(self, token, handle, logits_concat):
+        """Phase two: block until the fired fan-out resolves (the single
+        join point), then mix replies with gate weights — the same math
+        as :meth:`__call__`.  ``fire(...)`` immediately followed by
+        ``join(...)`` is the serial schedule and produces bitwise the
+        same values as deferring the join."""
+        y, idx, mask = self._join_op(token, handle)
+        return self._combine(y, idx, mask, logits_concat)
 
     # ---- custom-vjp dispatch crossing the network ----
 
@@ -338,107 +380,219 @@ class RemoteMixtureOfExperts:
     def _host_forward_impl(
         self, x, logits_concat, store_session: bool = True, trace=None
     ):
+        # serial schedule = fire immediately followed by join; the
+        # overlapped swarm step calls the same two halves with trunk
+        # compute in between, so the paths cannot drift apart
+        return self.dispatch_async(
+            x, logits_concat, store_session=store_session, trace=trace
+        ).join()
+
+    def _join_timeout(self, kind: str):
+        """Hard join deadline for the future-based path (None = the
+        legacy arm's unbounded watchdog-guarded wait).  Every RPC inside
+        the fan-out is already bounded by rpc_timeout and the quorum
+        grace, so a fan-out that outlives their sum plus the grace slack
+        is stalled, not slow."""
+        from learning_at_home_tpu.client.rpc import JOIN_GRACE_S
+
+        if dispatch_mode() == "legacy":
+            return None
+        base = self.forward_timeout if kind == "forward" else self.backward_timeout
+        return base + self.timeout_after_k_min + JOIN_GRACE_S
+
+    def _make_join_exit(self, trace):
+        """on_join_exit hook: overlap accounting + the in-flight gauge,
+        run in join's finally on the joining host thread — it fires even
+        when the join times out or the fan-out raised."""
+
+        def _exit(fut: DispatchFuture) -> None:
+            import time as _time
+
+            if fut.cancelled:
+                # ticket eviction: nothing was joined — drain the gauge
+                # but record no overlap evidence (a never-joined window
+                # is not hidden latency)
+                with self._sessions_lock:
+                    self.inflight_dispatches -= 1
+                return
+            blocked = fut.blocked_s
+            inflight = fut.inflight_s()
+            self.wait_times.append(blocked)
+            timeline.record(
+                "client.dispatch.join",
+                _time.monotonic() - blocked, blocked, trace=trace,
+            )
+            with self._sessions_lock:
+                self.inflight_dispatches -= 1
+                self.inflight_seconds += inflight
+                self.join_blocked_seconds += min(blocked, inflight)
+
+        return _exit
+
+    @sanitizer.runs_on("host", site="moe.dispatch_async")
+    def dispatch_async(
+        self, x, logits_concat, *, store_session: bool = True, trace=None,
+        session_id: Optional[int] = None,
+    ) -> DispatchFuture:
+        """FIRE half of a forward dispatch: alive-set lookup, per-sample
+        top-k selection, payload serialization (pipelined mode: pack-once
+        on this host thread) and a non-blocking submit of the quorum
+        fan-out to the client loop.  Returns a joinable
+        :class:`DispatchFuture` immediately — this path never waits for
+        expert replies.  Loop touches are control-plane only: grid
+        routing pays the once-per-TTL-window alive-set refresh;
+        ``routing="beam"`` pays a bounded DHT beam-search round-trip on
+        EVERY fire (prefix records are per-logit-row, not cacheable as
+        one set) — on real WAN RTTs that lookup shrinks the overlap win
+        by its latency, so latency-critical overlapped deployments
+        should prefer grid routing or a DHT cache (ROADMAP item 4).
+
+        ``session_id`` pins the backward-session key (the jax-level
+        fire/join pair uses the fire handle, so fire's residuals can
+        find the backward the join fired)."""
         import time as _time
 
         t0 = _time.monotonic()
         x = np.asarray(x)
         logits_concat = np.asarray(logits_concat)
         batch = x.shape[0]
-        logits = [
-            logits_concat[:, off : off + g]
-            for off, g in zip(self._grid_offsets, self.grid_size)
-        ]
-        if self.routing == "beam":
-            # prefix beam search: fetch only the records for each sample's
-            # best first-dimension rows — scales to 4096-expert grids
-            # without ever reading the full top-level record
-            alive = client_loop().run(
-                beam_search_alive(
-                    self.source,
-                    self.uid_prefix,
-                    logits,
-                    self.grid_size,
-                    self.beam_size,
-                )
-            )
-            alive_uids = sorted(alive)
-        else:
-            alive = client_loop().run(self.alive_cache.get())
-            alive_uids = sorted(
-                filter_valid_uids(alive, self.uid_prefix, self.grid_size)
-            )
-        if not alive_uids:
-            raise MoEDispatchError(
-                f"no alive experts under prefix {self.uid_prefix!r}"
-            )
-        bias = None
-        if self.latency_weight:
-            registry = pool_registry()
-            bias = np.zeros(len(alive_uids), np.float32)
-            for j, uid in enumerate(alive_uids):
-                pool = registry.peek(alive[uid])  # non-creating: see peek()
-                if pool is not None and pool.rtt_ema is not None:
-                    bias[j] = -self.latency_weight * pool.rtt_ema
-        sel, coords = select_top_k(
-            logits, alive_uids, self.k_best, bias=bias
-        )  # [B, k']
-        k_eff = sel.shape[1]
-        # which experts this dispatch actually selected — the observable
-        # the latency-aware-routing tests assert on (mechanism, not clock)
-        self.selection_log.append(
-            frozenset(alive_uids[e] for e in np.unique(sel))
-        )
-
-        # group rows by chosen expert: expert -> (rows, slots)
-        jobs: dict[int, tuple[np.ndarray, np.ndarray]] = {}
-        for j in range(k_eff):
-            for e in np.unique(sel[:, j]):
-                rows = np.nonzero(sel[:, j] == e)[0]
-                if e in jobs:
-                    jobs[e] = (
-                        np.concatenate([jobs[e][0], rows]),
-                        np.concatenate([jobs[e][1], np.full(len(rows), j)]),
+        with timeline.span("client.dispatch.fire", trace=trace):
+            logits = [
+                logits_concat[:, off : off + g]
+                for off, g in zip(self._grid_offsets, self.grid_size)
+            ]
+            if self.routing == "beam":
+                # prefix beam search: fetch only the records for each
+                # sample's best first-dimension rows — scales to
+                # 4096-expert grids without ever reading the full
+                # top-level record.  Control-plane: bounded DHT reads,
+                # not expert-reply waits.
+                alive = client_loop().run(
+                    beam_search_alive(
+                        self.source,
+                        self.uid_prefix,
+                        logits,
+                        self.grid_size,
+                        self.beam_size,
                     )
-                else:
-                    jobs[e] = (rows, np.full(len(rows), j))
-
-        prepared = None
-        if dispatch_mode() == "pipelined":
-            # payload slot left empty: _prepare_payloads slices each
-            # expert's rows from the ONE wire-cast batch — materializing
-            # x[rows] here too would double the hot-path memcpy
-            uid_jobs, prepared = self._prepare_payloads(
-                "forward",
-                {
-                    alive_uids[e]: (alive[alive_uids[e]], None, rows, slots)
-                    for e, (rows, slots) in jobs.items()
-                },
-                x_full=x,
-                trace=trace,
+                )
+                alive_uids = sorted(alive)
+            else:
+                # sync TTL-cache fast path: the fire half must not
+                # round-trip the loop per dispatch — only the expired
+                # window pays the (bounded, control-plane) refresh
+                alive = self.alive_cache.peek_fresh()
+                if alive is None:
+                    alive = client_loop().run(self.alive_cache.get())
+                alive_uids = sorted(
+                    filter_valid_uids(alive, self.uid_prefix, self.grid_size)
+                )
+            if not alive_uids:
+                raise MoEDispatchError(
+                    f"no alive experts under prefix {self.uid_prefix!r}"
+                )
+            bias = None
+            if self.latency_weight:
+                registry = pool_registry()
+                bias = np.zeros(len(alive_uids), np.float32)
+                for j, uid in enumerate(alive_uids):
+                    pool = registry.peek(alive[uid])  # non-creating: see peek()
+                    if pool is not None and pool.rtt_ema is not None:
+                        bias[j] = -self.latency_weight * pool.rtt_ema
+            sel, coords = select_top_k(
+                logits, alive_uids, self.k_best, bias=bias
+            )  # [B, k']
+            k_eff = sel.shape[1]
+            # which experts this dispatch actually selected — the observable
+            # the latency-aware-routing tests assert on (mechanism, not clock)
+            self.selection_log.append(
+                frozenset(alive_uids[e] for e in np.unique(sel))
             )
-        else:
-            uid_jobs = {
-                alive_uids[e]: (alive[alive_uids[e]], x[rows], rows, slots)
-                for e, (rows, slots) in jobs.items()
-            }
-        t_wait = _time.monotonic()
-        with dispatch_wait_watchdog(
-            self._slowest_rtt(uid_jobs),
-            what=f"forward dispatch ({self.uid_prefix}, {batch} rows)",
-        ):
-            results = client_loop().run(
-                self._quorum_fanout(
-                    msg_type="forward",
-                    jobs=uid_jobs,
-                    batch=batch,
-                    quorum=self.k_min,
-                    rpc_timeout=self.forward_timeout,
-                    prepared=prepared,
+
+            # group rows by chosen expert: expert -> (rows, slots)
+            jobs: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+            for j in range(k_eff):
+                for e in np.unique(sel[:, j]):
+                    rows = np.nonzero(sel[:, j] == e)[0]
+                    if e in jobs:
+                        jobs[e] = (
+                            np.concatenate([jobs[e][0], rows]),
+                            np.concatenate([jobs[e][1], np.full(len(rows), j)]),
+                        )
+                    else:
+                        jobs[e] = (rows, np.full(len(rows), j))
+
+            prepared = None
+            if dispatch_mode() == "pipelined":
+                # payload slot left empty: _prepare_payloads slices each
+                # expert's rows from the ONE wire-cast batch — materializing
+                # x[rows] here too would double the hot-path memcpy
+                uid_jobs, prepared = self._prepare_payloads(
+                    "forward",
+                    {
+                        alive_uids[e]: (alive[alive_uids[e]], None, rows, slots)
+                        for e, (rows, slots) in jobs.items()
+                    },
+                    x_full=x,
                     trace=trace,
                 )
-            )
-        self.wait_times.append(_time.monotonic() - t_wait)
+            else:
+                uid_jobs = {
+                    alive_uids[e]: (alive[alive_uids[e]], x[rows], rows, slots)
+                    for e, (rows, slots) in jobs.items()
+                }
 
+        coro = self._quorum_fanout(
+            msg_type="forward",
+            jobs=uid_jobs,
+            batch=batch,
+            quorum=self.k_min,
+            rpc_timeout=self.forward_timeout,
+            prepared=prepared,
+            trace=trace,
+        )
+
+        fut_box: list = []
+
+        def finalize(results):
+            # dispatch latency ends when the FAN-OUT resolved (stamped on
+            # the loop thread), not when the caller got around to joining:
+            # under the overlapped schedule now-minus-t0 would fold the
+            # deliberately hidden trunk compute into the north-star
+            # dispatch p50 and make overlap read as a latency regression
+            t_end = fut_box[0].completed_at if fut_box else None
+            return self._finalize_forward(
+                results, x=x, coords=coords, sel=sel, batch=batch,
+                store_session=store_session, session_id=session_id,
+                trace=trace, t0=t0, t_end=t_end,
+            )
+
+        fut = DispatchFuture(
+            "forward", coro, finalize,
+            join_timeout=self._join_timeout("forward"),
+            watchdog_rtt=(
+                self._slowest_rtt(uid_jobs)
+                if dispatch_mode() == "legacy" else None
+            ),
+            what=f"forward dispatch ({self.uid_prefix}, {batch} rows)",
+            on_join_exit=self._make_join_exit(trace),
+        )
+        fut_box.append(fut)
+        with self._sessions_lock:
+            self.inflight_dispatches += 1
+        return fut
+
+    def _finalize_forward(
+        self, results, *, x, coords, sel, batch, store_session, session_id,
+        trace, t0, t_end=None,
+    ):
+        """JOIN-side accumulation of a forward fan-out's replies into the
+        (y, idx, mask, cid) quadruple — quorum accounting, per-sample
+        degradation, and the backward-session store.  Runs on the joining
+        host thread via DispatchFuture's finalizer."""
+        import time as _time
+
+        k_eff = sel.shape[1]
         y = np.zeros((batch, self.k_best, x.shape[1]), x.dtype)
         mask = np.zeros((batch, self.k_best), bool)
         idx = np.zeros((batch, self.k_best, self.n_dims), np.int32)
@@ -483,7 +637,9 @@ class RemoteMixtureOfExperts:
 
         cid = -1
         if store_session:
-            cid = next(self._call_counter)
+            cid = session_id if session_id is not None else next(
+                self._call_counter
+            )
             with self._sessions_lock:
                 # the forward-dropped mask rides along so the backward path
                 # doesn't re-count those samples as backward failures; the
@@ -491,7 +647,9 @@ class RemoteMixtureOfExperts:
                 self._sessions[cid] = (session, dropped.copy(), trace)
                 while len(self._sessions) > self.max_sessions:
                     self._sessions.popitem(last=False)
-        self.dispatch_times.append(_time.monotonic() - t0)
+        self.dispatch_times.append(
+            (t_end if t_end is not None else _time.monotonic()) - t0
+        )
         self.dispatches += 1
         return y, idx, mask, np.int32(cid)
 
@@ -770,11 +928,24 @@ class RemoteMixtureOfExperts:
             )
 
         codec_counts = self._snap_codec_counts()
+        # time-weighted overlap: the fraction of all in-flight RPC time
+        # this layer's caller hid behind its own compute (0.0 in the
+        # serial regime, > 0 once a scheduler defers its joins)
+        inflight_s = self.inflight_seconds
+        blocked_s = self.join_blocked_seconds
+        overlap = (
+            max(0.0, min(1.0, 1.0 - blocked_s / inflight_s))
+            if inflight_s > 0 else 0.0
+        )
         return {
             **{
                 f"lah_client_wire_codec_payloads_total_codec_{c}": n
                 for c, n in codec_counts.items()
             },
+            "lah_client_overlap_fraction": round(overlap, 4),
+            "lah_client_inflight_dispatches": self.inflight_dispatches,
+            "lah_client_inflight_seconds_total": round(inflight_s, 3),
+            "lah_client_join_blocked_seconds_total": round(blocked_s, 3),
             "lah_client_dispatches_total": self.dispatches,
             "lah_client_samples_total": self.samples_total,
             "lah_client_samples_dropped_total": self.samples_dropped,
@@ -812,6 +983,11 @@ class RemoteMixtureOfExperts:
                 m["lah_client_pack_once_bytes_saved_total"]
             ),
             "dispatches": int(m["lah_client_dispatches_total"]),
+            # who is actually overlapping (ISSUE 7): time-weighted hidden
+            # fraction of the in-flight RPC windows + the live gauge of
+            # fired-but-unjoined dispatches
+            "overlap_fraction": m["lah_client_overlap_fraction"],
+            "inflight_dispatches": int(m["lah_client_inflight_dispatches"]),
             "bytes_sent": int(sum(p.bytes_sent for p in pools)),
             "bytes_received": int(sum(p.bytes_received for p in pools)),
             "inflight_depth_max": max(
@@ -850,38 +1026,59 @@ class RemoteMixtureOfExperts:
             return self._host_backward_impl(session, fwd_dropped, trace, gy)
 
     def _host_backward_impl(self, session, fwd_dropped, trace, gy):
+        return self.backward_async(session, fwd_dropped, trace, gy).join()
+
+    @sanitizer.runs_on("host", site="moe.backward_async")
+    def backward_async(self, session, fwd_dropped, trace, gy) -> DispatchFuture:
+        """FIRE half of a backward dispatch: serialize the gradient
+        fan-out (reusing the forward's already-encoded session rows) and
+        submit it non-blocking — the mirror of :meth:`dispatch_async`,
+        so backward trunk compute can overlap the grad RPCs too."""
         batch = gy.shape[0]
         with self._sessions_lock:
             self.backward_rpcs_sent += len(session)
-        prepared = None
-        if dispatch_mode() == "pipelined":
-            uid_jobs, prepared = self._prepare_payloads(
-                "backward", session, gy_full=gy, trace=trace
-            )
-        else:
-            uid_jobs = {
-                uid: (ep, x_rows, rows, slots, gy[rows, slots])
-                for uid, (ep, x_rows, rows, slots) in session.items()
-            }
-        import time as _time
-
-        t_wait = _time.monotonic()
-        with dispatch_wait_watchdog(
-            self._slowest_rtt(uid_jobs),
-            what=f"backward dispatch ({self.uid_prefix}, {batch} rows)",
-        ):
-            results = client_loop().run(
-                self._quorum_fanout(
-                    msg_type="backward",
-                    jobs=uid_jobs,
-                    batch=batch,
-                    quorum=self.backward_k_min,
-                    rpc_timeout=self.backward_timeout,
-                    prepared=prepared,
-                    trace=trace,
+        with timeline.span("client.dispatch.fire", trace=trace):
+            prepared = None
+            if dispatch_mode() == "pipelined":
+                uid_jobs, prepared = self._prepare_payloads(
+                    "backward", session, gy_full=gy, trace=trace
                 )
+            else:
+                uid_jobs = {
+                    uid: (ep, x_rows, rows, slots, gy[rows, slots])
+                    for uid, (ep, x_rows, rows, slots) in session.items()
+                }
+        coro = self._quorum_fanout(
+            msg_type="backward",
+            jobs=uid_jobs,
+            batch=batch,
+            quorum=self.backward_k_min,
+            rpc_timeout=self.backward_timeout,
+            prepared=prepared,
+            trace=trace,
+        )
+
+        def finalize(results):
+            return self._finalize_backward(
+                results, session=session, fwd_dropped=fwd_dropped,
+                gy=gy, batch=batch,
             )
-        self.wait_times.append(_time.monotonic() - t_wait)
+
+        fut = DispatchFuture(
+            "backward", coro, finalize,
+            join_timeout=self._join_timeout("backward"),
+            watchdog_rtt=(
+                self._slowest_rtt(uid_jobs)
+                if dispatch_mode() == "legacy" else None
+            ),
+            what=f"backward dispatch ({self.uid_prefix}, {batch} rows)",
+            on_join_exit=self._make_join_exit(trace),
+        )
+        with self._sessions_lock:
+            self.inflight_dispatches += 1
+        return fut
+
+    def _finalize_backward(self, results, *, session, fwd_dropped, gy, batch):
         gx = np.zeros((batch, gy.shape[-1]), gy.dtype)
         ok = np.zeros(batch, np.int64)
         with self._sessions_lock:
@@ -926,6 +1123,229 @@ class RemoteMixtureOfExperts:
                 self.backward_k_min,
             )
         return gx
+
+    # ---- jax-level fire/join ops (the overlapped step's host bridge) ----
+
+    @staticmethod
+    def _host_call(cb, specs, *args):
+        """``io_callback`` when TRACED (jit); a direct host invocation on
+        the caller's thread when eager.
+
+        Eagerly, routing the callback through XLA's host-callback
+        machinery executes it on an XLA-owned thread that shares the
+        (small) CPU execution pool with any program the caller launches
+        between fire and join — on 1-core hosts the callback's
+        ``np.asarray(arg)`` then deadlocks against exactly the trunk
+        compute the overlapped schedule runs concurrently (the
+        round-2/ROUND5 hazard shape; reproduced 2026-08-04 with eager
+        overlap at d_model ≥ 256).  A direct call has identical
+        semantics — fire never blocks, join blocks in plain Python — with
+        no XLA thread in the loop, so the hazard cannot exist there.
+        Under jit every operand is a tracer and the io_callback path is
+        taken; there XLA owns the whole schedule (one program contains
+        fire, trunk and join) and the pinned regression test covers it."""
+        if any(isinstance(a, jax.core.Tracer) for a in args):
+            return io_callback(cb, specs, *args)
+        return cb(*[np.asarray(a) for a in args])
+
+    def _build_async_ops(self):
+        """The fire/join custom-vjp pair behind the overlapped swarm step.
+
+        ``fire_op(x, logits) -> (token, handle)``: the host callback runs
+        the fire half (selection + payload prep + non-blocking fan-out
+        submit) and returns an int32 ticket; ``token`` is ``x`` passed
+        through so the graph keeps a float path from input to output.
+        ``join_op(token, handle) -> (y, idx, mask)``: the host callback
+        joins the ticket's DispatchFuture — the SINGLE blocking point.
+        Only the scalar handle crosses into the join callback, so the
+        blocking callback never waits on large input buffers (the ROUND5
+        io_callback-hang ingredient).
+
+        Backward mirrors the structure in reverse order: join's bwd
+        FIRES the backward fan-out (its io_callback returns a zeros
+        cotangent for ``token`` purely to keep the backward graph
+        ordered), and fire's bwd JOINS it — so the backward trunk
+        compute scheduled between them overlaps the grad RPCs exactly
+        like the forward."""
+        int_spec = jax.ShapeDtypeStruct((), jnp.int32)
+
+        def join_specs(b, d, dtype):
+            return (
+                jax.ShapeDtypeStruct((b, self.k_best, d), dtype),  # y
+                jax.ShapeDtypeStruct((b, self.k_best, self.n_dims), jnp.int32),
+                jax.ShapeDtypeStruct((b, self.k_best), jnp.bool_),
+                jax.ShapeDtypeStruct((), jnp.int32),  # session id
+            )
+
+        @jax.custom_vjp
+        def fire_op(x, logits_concat):
+            # no-grad primal path (inference): no backward will come, so
+            # the join must not store a session
+            handle = self._host_call(
+                lambda xx, lc: self._host_fire(xx, lc, store_session=False),
+                int_spec, x, logits_concat,
+            )
+            return x, handle
+
+        def fire_fwd(x, logits_concat):
+            handle = self._host_call(
+                lambda xx, lc: self._host_fire(xx, lc, store_session=True),
+                int_spec, x, logits_concat,
+            )
+            return (x, handle), (handle, x, logits_concat)
+
+        def fire_bwd(residuals, cotangents):
+            handle, x, logits_concat = residuals
+            g_token = cotangents[0]  # handle is int: no cotangent
+            # join the backward fan-out the join op's bwd fired; the
+            # g_token operand orders this callback after that one
+            gx = self._host_call(
+                self._host_join_backward,
+                jax.ShapeDtypeStruct(x.shape, x.dtype),
+                handle, g_token,
+            )
+            # token is an identity passthrough of x: any OTHER consumer's
+            # cotangent (g_token — zeros in the fire/join pairing) adds
+            # to the experts' input-gradient
+            return gx + g_token, jnp.zeros_like(logits_concat)
+
+        fire_op.defvjp(fire_fwd, fire_bwd)
+
+        @jax.custom_vjp
+        def join_op(token, handle):
+            y, idx, mask, _cid = self._host_call(
+                self._host_join,
+                join_specs(token.shape[0], token.shape[1], token.dtype),
+                handle,
+            )
+            return y, idx, mask
+
+        def join_fwd(token, handle):
+            y, idx, mask, cid = self._host_call(
+                self._host_join,
+                join_specs(token.shape[0], token.shape[1], token.dtype),
+                handle,
+            )
+            return (y, idx, mask), (cid, token)
+
+        def join_bwd(residuals, cotangents):
+            cid, token = residuals
+            gy = cotangents[0]  # idx/mask are int/bool: no cotangent
+            g_token = self._host_call(
+                self._host_fire_backward,
+                jax.ShapeDtypeStruct(token.shape, token.dtype),
+                cid, gy,
+            )
+            # handle (int32) takes a float0 cotangent
+            handle_cot = np.zeros((), dtype=jax.dtypes.float0)
+            return g_token, handle_cot
+
+        join_op.defvjp(join_fwd, join_bwd)
+        return fire_op, join_op
+
+    def _host_fire(self, x, logits_concat, store_session: bool = True):
+        trace = new_trace_id() if timeline.enabled else None
+        fid = next(self._call_counter)
+        fut = self.dispatch_async(
+            x, logits_concat, store_session=store_session, trace=trace,
+            session_id=fid,
+        )
+        evicted = []
+        with self._sessions_lock:
+            self._pending[fid] = fut
+            while len(self._pending) > self.max_sessions:
+                evicted.append(self._pending.popitem(last=False))
+        # cancel OUTSIDE the lock: the future's join-exit hook re-acquires
+        # it to drain the in-flight gauge
+        for stale_fid, stale in evicted:
+            stale.cancel()
+            logger.warning(
+                "evicted un-joined dispatch ticket %d — a fire without "
+                "a join leaks an in-flight fan-out (raise max_sessions, "
+                "or join what you fire)", stale_fid,
+            )
+        return np.int32(fid)
+
+    def _host_join(self, handle):
+        fid = int(handle)
+        with self._sessions_lock:
+            fut = self._pending.pop(fid, None)
+        if fut is None:
+            raise MoEDispatchError(
+                f"no in-flight dispatch {fid}: join without fire, or the "
+                "ticket was evicted (raise max_sessions?)"
+            )
+        try:
+            return fut.join()
+        except Exception as e:
+            # a failed/timed-out join must surface as THE diagnosable
+            # dispatch error, never a hang (the retired ROUND5 class)
+            if isinstance(e, MoEDispatchError):
+                raise
+            raise MoEDispatchError(
+                f"dispatch {fid} join failed: {type(e).__name__}: {e}"
+            ) from e
+
+    def _host_fire_backward(self, cid, gy):
+        gy = np.asarray(gy)
+        cid = int(cid)
+        with self._sessions_lock:
+            entry = self._sessions.pop(cid, None)
+        if entry is None:
+            raise MoEDispatchError(
+                f"no dispatch session {cid}: backward without forward, "
+                "or session evicted (raise max_sessions?)"
+            )
+        session, fwd_dropped, trace = entry
+        fut = self.backward_async(session, fwd_dropped, trace, gy)
+        evicted = []
+        with self._sessions_lock:
+            self._pending_bwd[cid] = fut
+            while len(self._pending_bwd) > self.max_sessions:
+                evicted.append(self._pending_bwd.popitem(last=False))
+        for _sf, stale in evicted:  # outside the lock: see _host_fire
+            stale.cancel()
+        # the zeros cotangent for token: pure graph ordering (the joining
+        # fire_bwd callback consumes it, so it runs after this one)
+        return np.zeros((gy.shape[0], gy.shape[-1]), gy.dtype)
+
+    def _host_join_backward(self, handle, _g_token):
+        fid = int(handle)
+        with self._sessions_lock:
+            fut = self._pending_bwd.pop(fid, None)
+        if fut is None:
+            raise MoEDispatchError(
+                f"no in-flight backward {fid}: the join op's bwd never "
+                "fired (session evicted?)"
+            )
+        try:
+            return fut.join()
+        except Exception as e:
+            # same contract as _host_join: a failed/timed-out backward
+            # join surfaces as THE diagnosable dispatch error
+            if isinstance(e, MoEDispatchError):
+                raise
+            raise MoEDispatchError(
+                f"backward dispatch {fid} join failed: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+
+    def discard(self, token=None, handle=None, logits_concat=None) -> None:
+        """Error-path cleanup for a fired-but-unjoined dispatch: pop the
+        ticket and cancel its fan-out (draining the in-flight gauge),
+        so an exception between :meth:`fire` and :meth:`join` never
+        leaks an in-flight fan-out until eviction.  Accepts the full
+        ``fire(...)`` return tuple (``discard(*pending)``); a no-op for
+        already-joined tickets and for tracers (under jit the callbacks
+        never ran at trace time — there is nothing to cancel)."""
+        try:
+            fid = int(handle)
+        except TypeError:
+            return
+        with self._sessions_lock:
+            fut = self._pending.pop(fid, None)
+        if fut is not None:
+            fut.cancel()
 
     # ---- the k-of-n gather loop (shared by forward and backward) ----
 

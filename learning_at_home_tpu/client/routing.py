@@ -250,6 +250,18 @@ class CachedAliveSet:
             self._stamp = now
         return self._cached
 
+    def peek_fresh(self) -> Optional[dict[str, Endpoint]]:
+        """The cached alive set if still within TTL, else None — a pure
+        sync read with no loop round-trip, so the fire half of a
+        future-based dispatch only touches the client loop at all on the
+        one-per-TTL-window refresh (a bounded control-plane lookup)."""
+        if (
+            self._cached is not None
+            and time.monotonic() - self._stamp <= self.ttl
+        ):
+            return self._cached
+        return None
+
 
 def score_experts(
     logits_per_dim: Sequence[np.ndarray], coords: np.ndarray

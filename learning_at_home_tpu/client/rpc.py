@@ -7,11 +7,13 @@ the TPU-build replacement for the reference's thread-per-call dispatch.
 
 from __future__ import annotations
 
+import concurrent.futures
 import contextlib
 import logging
 import os
 import threading
-from typing import Optional
+import time
+from typing import Any, Callable, Coroutine, Optional
 
 from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
@@ -174,6 +176,168 @@ def dispatch_wait_watchdog(rtt_ema: Optional[float], what: str = "dispatch"):
         yield
     finally:
         timer.cancel()
+
+
+# --------------------------------------------------------------------------
+# future-based dispatch core (ISSUE 7): the fire half of a dispatch
+# submits its quorum fan-out coroutine to the lah-client loop and
+# immediately returns a joinable DispatchFuture — the caller's host
+# thread is free to keep computing anything not data-dependent on the
+# replies, and joins as late as the dependency allows.  The ROUND5
+# io_callback-hang hazard class is retired BY CONSTRUCTION here: the
+# fire path never waits on the loop at all, and the join is one bounded
+# wait on a concurrent future resolved by the loop thread (no nested
+# loop waits, and — in pipelined mode — a hard timeout that turns a
+# stalled pool into a diagnosable error instead of a silent hang; the
+# legacy A/B arm keeps the PR-5 watchdog + unbounded wait semantics).
+# --------------------------------------------------------------------------
+
+# extra slack on top of (rpc_timeout + timeout_after_k_min) before a
+# pipelined join gives up on its fan-out: first exchanges against a cold
+# server legitimately include connects and warmup compiles
+JOIN_GRACE_S = float(os.environ.get("LAH_DISPATCH_JOIN_GRACE_S", "30"))
+
+
+class DispatchJoinTimeout(RuntimeError):
+    """A DispatchFuture.join exceeded its hard deadline: the fan-out
+    coroutine never resolved.  The fan-out task is cancelled before this
+    is raised, so the loop is left clean.  Suspect a stalled/black-holed
+    pool (a peer accepting connections but never replying) — the
+    condition the legacy path's dispatch-wait watchdog could only WARN
+    about is a clean, catchable error on the future-based path."""
+
+
+class DispatchFuture:
+    """A joinable in-flight expert fan-out.
+
+    Created on the caller's host thread by the fire half of a dispatch
+    (``RemoteMixtureOfExperts.dispatch_async`` / ``backward_async``)
+    AFTER payload serialization: construction submits the quorum fan-out
+    coroutine to the ``lah-client`` loop and returns immediately — it
+    never blocks on the loop (sanitizer site ``rpc.DispatchFuture.fire``
+    would be the place to assert that, but construction does no waiting
+    by construction).  :meth:`join` blocks the calling host thread until
+    the fan-out resolves, runs the supplied finalizer on its results,
+    and reports how much of the in-flight window the caller actually
+    hid behind other work (the ``overlap fraction`` observable).
+
+    Join semantics by dispatch mode:
+
+    - ``join_timeout`` set (pipelined): hard deadline; on expiry the
+      fan-out task is cancelled and :class:`DispatchJoinTimeout` raises.
+    - ``join_timeout`` None (legacy A/B arm): unbounded wait guarded by
+      the once-per-process ``dispatch_wait_watchdog`` — the exact PR-5
+      behavior, kept as the regression baseline.
+    """
+
+    def __init__(
+        self,
+        kind: str,
+        coro: Coroutine,
+        finalize: Callable[[Any], Any],
+        *,
+        join_timeout: Optional[float] = None,
+        watchdog_rtt: Optional[float] = None,
+        what: str = "dispatch",
+        on_join_exit: Optional[Callable[["DispatchFuture"], None]] = None,
+    ):
+        self.kind = kind
+        self._finalize = finalize
+        self._join_timeout = join_timeout
+        self._watchdog_rtt = watchdog_rtt
+        self._what = what
+        self._on_join_exit = on_join_exit
+        self.joined = False
+        self.cancelled = False
+        # overlap accounting (read by the finalizer/owner after join):
+        # fired_at -> completed_at is the in-flight window; the slice of
+        # it NOT spent blocked inside join() was hidden behind caller
+        # compute.  completed_at is stamped on the loop thread the moment
+        # the fan-out coroutine settles (plain float store — no lock; the
+        # join thread only reads it after the future resolved).
+        self.completed_at: Optional[float] = None
+        self.blocked_s: float = 0.0
+        self.fired_at = time.monotonic()
+        self._cf = client_loop().submit(self._timed(coro))
+
+    async def _timed(self, coro: Coroutine):
+        try:
+            return await coro
+        finally:
+            self.completed_at = time.monotonic()
+
+    def done(self) -> bool:
+        return self._cf.done()
+
+    def cancel(self) -> None:
+        """Best-effort cancel of the in-flight fan-out (the
+        ticket-eviction path).  Marks the future consumed and runs the
+        join-exit hook once, so the owner's in-flight accounting drains
+        — an evicted, never-joined ticket must not leak the
+        ``inflight_dispatches`` gauge."""
+        self.cancelled = True
+        self._cf.cancel()
+        if not self.joined:
+            self.joined = True
+            if self._on_join_exit is not None:
+                self._on_join_exit(self)
+
+    # ---- overlap observables (valid after join) ----
+
+    def inflight_s(self) -> float:
+        end = self.completed_at
+        if end is None:
+            end = time.monotonic()
+        return max(end - self.fired_at, 0.0)
+
+    def overlap_fraction(self) -> float:
+        """Fraction of the in-flight window hidden behind caller compute
+        (0.0 = the caller joined immediately and ate the whole wait —
+        the serial regime; → 1.0 = the replies were already in when the
+        caller finally joined)."""
+        inflight = self.inflight_s()
+        if inflight <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, (inflight - self.blocked_s) / inflight))
+
+    @sanitizer.runs_on("host", site="rpc.DispatchFuture.join")
+    def join(self, timeout: Optional[float] = None) -> Any:
+        """Block this host thread until the fan-out resolves; return the
+        finalizer's output.  Never call from a loop thread: the wait
+        would starve the loop that must resolve it (asserted via the
+        sanitizer site above; ``BackgroundLoop.run``'s always-on guard
+        covers the submit-side shape)."""
+        if self.joined:
+            raise RuntimeError(f"{self.kind} DispatchFuture joined twice")
+        self.joined = True
+        deadline = timeout if timeout is not None else self._join_timeout
+        t_block = time.monotonic()
+        try:
+            if deadline is None:
+                # legacy arm: unbounded wait under the PR-5 watchdog —
+                # the hang class stays diagnosable there, not fatal
+                with dispatch_wait_watchdog(
+                    self._watchdog_rtt, what=self._what
+                ):
+                    results = self._cf.result()
+            else:
+                try:
+                    results = self._cf.result(deadline)
+                except concurrent.futures.TimeoutError:
+                    self._cf.cancel()
+                    raise DispatchJoinTimeout(
+                        f"{self._what}: fan-out did not resolve within "
+                        f"{deadline:.1f}s of join — cancelled the in-flight "
+                        "task.  A pool is stalled (accepting but never "
+                        "replying), or the join deadline is below the "
+                        "server's warmup-compile window; see "
+                        "LAH_DISPATCH_JOIN_GRACE_S."
+                    ) from None
+        finally:
+            self.blocked_s = time.monotonic() - t_block
+            if self._on_join_exit is not None:
+                self._on_join_exit(self)
+        return self._finalize(results)
 
 
 def client_loop() -> BackgroundLoop:

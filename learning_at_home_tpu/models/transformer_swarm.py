@@ -136,8 +136,60 @@ class SwarmDMoETransformerLM:
         x = layer_norm(params["ln_f"], x)
         return x @ params["embed"].T
 
+    def apply_overlapped(self, params, token_ids, *, overlap: bool = True):
+        """ScMoE-style parallel-branch step with communication/compute
+        overlap (ISSUE 7; cf. Shortcut-connected Expert Parallelism,
+        arXiv:2404.05019).
+
+        Architecture note — this is a DIFFERENT (shortcut) wiring from
+        :meth:`apply`: each layer's MoE branch reads ``ln2`` of the layer
+        INPUT (not the post-attention residual), so the expert fan-out
+        for layer *i* has no data dependency on layer *i*'s attention and
+        can be FIRED before it.  The overlapped schedule fires the MoE,
+        computes the attention trunk while the RPCs fly, and joins the
+        future only where the residual add needs the replies.  Backward
+        mirrors it automatically: the join op's bwd fires the grad
+        fan-out, the attention backward computes, and the fire op's bwd
+        joins (client/moe.py).
+
+        ``overlap=False`` runs the SAME primitive ops in the serial
+        schedule (join immediately after fire) — only host-side
+        scheduling differs, so serial and overlapped outputs and
+        gradients are bitwise identical; that is the A/B contract
+        bench.py and the parity tests rely on."""
+        cfg = self.cfg
+        b, s = token_ids.shape
+        x = params["embed"][token_ids] + params["pos"][None, :s]
+        for i, lp in enumerate(params["layers"]):
+            moe_in = layer_norm(lp["ln2"], x).reshape(b * s, cfg.d_model)
+            pending = self.moes[i].fire(moe_in, lp["gate"])
+            try:
+                if not overlap:  # serial schedule: eat the wait right here
+                    moe_out = self.moes[i].join(*pending)
+                x = x + causal_attention(
+                    lp, layer_norm(lp["ln1"], x), cfg.n_heads
+                )
+                if overlap:  # join as late as the data dependency allows
+                    moe_out = self.moes[i].join(*pending)
+            except Exception:
+                # a raise between fire and join must not leak the
+                # in-flight fan-out until ticket eviction (no-op if the
+                # join already consumed it)
+                self.moes[i].discard(*pending)
+                raise
+            x = x + moe_out.reshape(b, s, cfg.d_model)
+        x = layer_norm(params["ln_f"], x)
+        return x @ params["embed"].T
+
     def loss_fn(self, params, token_ids, targets):
         logits = self.apply(params, token_ids)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    def loss_fn_overlapped(self, params, token_ids, targets, *,
+                           overlap: bool = True):
+        logits = self.apply_overlapped(params, token_ids, overlap=overlap)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, targets
         ).mean()
@@ -150,5 +202,25 @@ class SwarmDMoETransformerLM:
             loss, grads = jax.value_and_grad(self.loss_fn)(params, ids, targets)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
+
+        return step
+
+    def make_overlapped_train_step(
+        self, optimizer: optax.GradientTransformation, *,
+        overlap: bool = True,
+    ) -> Callable:
+        """Train step over the shortcut architecture — ``overlap``
+        selects the schedule (overlapped vs serial) without changing a
+        single primitive op; see :meth:`apply_overlapped`."""
+
+        def loss(params, ids, targets):
+            return self.loss_fn_overlapped(
+                params, ids, targets, overlap=overlap
+            )
+
+        def step(params, opt_state, ids, targets):
+            loss_val, grads = jax.value_and_grad(loss)(params, ids, targets)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss_val
 
         return step

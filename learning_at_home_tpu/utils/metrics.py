@@ -230,10 +230,22 @@ class MetricsRegistry:
 
         Merge rule: names ending in ``_total`` SUM across collectors
         (event counts from two MoE layers or two co-hosted servers add
-        up); everything else takes the MAX — percentiles, queue depths
-        and other distribution-shaped gauges are NOT additive (summing
-        two layers' dispatch p50s would report 2× the true latency), and
+        up), and so do names ending in ``_inflight`` / containing
+        ``_inflight_`` — additive occupancy gauges like
+        ``lah_client_inflight_dispatches`` (ISSUE 7: three layers each
+        holding one fired-but-unjoined fan-out means THREE dispatches in
+        flight, not one); everything else takes the MAX — percentiles,
+        queue depths, fractions (``lah_client_overlap_fraction``) and
+        other distribution-shaped gauges are NOT additive (summing two
+        layers' dispatch p50s would report 2× the true latency), and
         worst-across-instances is the honest aggregate for them."""
+
+        def additive(name: str) -> bool:
+            return (
+                name.endswith("_total")
+                or name.endswith("_inflight")
+                or "_inflight_" in name
+            )
         with self._lock:
             collectors = list(self._collectors.items())
         out: dict[str, float] = {}
@@ -255,7 +267,7 @@ class MetricsRegistry:
                     continue
                 if name in out:
                     out[name] = (
-                        out[name] + v if name.endswith("_total")
+                        out[name] + v if additive(name)
                         else max(out[name], v)
                     )
                 else:

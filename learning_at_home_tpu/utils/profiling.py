@@ -45,6 +45,17 @@ serialize-vs-wait breakdown also surfaces without profiling enabled via
 ``RemoteMixtureOfExperts.pack_times`` / ``wait_times`` and
 ``dispatch_stats()``.
 
+The FUTURE-BASED dispatch core (ISSUE 7) splits each dispatch into two
+first-class spans: ``client.dispatch.fire`` (selection + payload prep +
+non-blocking fan-out submit, on the host thread) and
+``client.dispatch.join`` (the time the caller actually BLOCKED waiting
+for replies — emitted from the join's finally, so a timed-out join
+still records).  The gap between a dispatch's fire span and its join
+span is trunk compute overlapped with the in-flight RPCs; the
+time-weighted aggregate surfaces always-on as
+``lah_client_overlap_fraction`` (utils/metrics.py, ``dispatch_stats()``)
+— the overlapped swarm step's headline observable.
+
 The trainer-side AVERAGING subsystem (ISSUE 3) records per-round
 ``averaging.round`` spans and the counters ``averaging.rounds``,
 ``averaging.degraded_rounds``, ``averaging.bytes_sent``; like the client

@@ -51,6 +51,157 @@ def pin_cpu_if_axon(reason: str = "") -> None:
         print(f"# pinned JAX to cpu ({why})", flush=True)
 
 
+# PDEATHSIG exec wrapper: the child re-execs python with prctl(PR_SET_
+# PDEATHSIG, SIGKILL) armed, so a dying launcher can never orphan its
+# servers (the exact failure find_orphan_servers exists to catch).
+PDEATHSIG_WRAPPER = (
+    "import ctypes, os, sys; "
+    "ctypes.CDLL('libc.so.6').prctl(1, 9); "
+    "os.execv(sys.executable, [sys.executable] + sys.argv[1:])"
+)
+
+
+def spawn_expert_servers(
+    repo_root: str,
+    prefix: str,
+    latencies,
+    *,
+    d_model: int = 512,
+    num_experts: int = 2,
+    expert_cls: str = "nop",
+    probe_timeout_s: float = 120.0,
+    extra_args: tuple = (),
+):
+    """Spawn one subprocess expert server per entry of ``latencies``
+    (each with that injected chaos reply latency; 0 = none), under the
+    PDEATHSIG wrapper, and block until every server answers a probe
+    forward.  Returns ``(procs, ports)``; on any boot failure every
+    started server is killed before the error propagates.
+
+    Shared by the overlap bench A/B (bench.py) and the collect-gate
+    overlap smoke: SUBPROCESS isolation is load-bearing there — an
+    in-process server shares the client's GIL, and compute the client
+    hides inside the in-flight RPC window starves the server's loops,
+    growing the window by exactly the hidden time (observed 2026-08-04).
+    ``nop`` experts keep the window pure latency."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    import numpy as np
+
+    from learning_at_home_tpu.client import RemoteExpert
+    from learning_at_home_tpu.utils.connection import RemoteCallError
+
+    procs, ports = [], []
+    try:
+        for layer, delay in enumerate(latencies):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+            cmd = [
+                sys.executable, "-c", PDEATHSIG_WRAPPER,
+                "-m", "learning_at_home_tpu.server",
+                "--expert-prefix", f"{prefix}{layer}",
+                "--num-experts", str(num_experts),
+                "--expert-cls", expert_cls, "--hidden-dim", str(d_model),
+                "--port", str(ports[-1]), "--no-dht",
+                "--max-batch-size", "4096",
+                "--optimizer", "sgd", "--lr", "0",
+                *extra_args,
+            ]
+            if delay:
+                cmd += ["--chaos-latency", str(delay)]
+            procs.append(subprocess.Popen(
+                cmd, env=clean_jax_subprocess_env(repo_root),
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            ))
+        deadline = time.time() + probe_timeout_s
+        for layer, port in enumerate(ports):
+            probe = RemoteExpert(
+                f"{prefix}{layer}.0", ("127.0.0.1", port), timeout=10.0
+            )
+            while True:
+                try:
+                    probe.forward_blocking(
+                        [np.ones((2, d_model), np.float32)]
+                    )
+                    break
+                except (OSError, RemoteCallError):
+                    if (
+                        any(p.poll() is not None for p in procs)
+                        or time.time() > deadline
+                    ):
+                        raise RuntimeError(
+                            f"expert server {prefix}{layer} never came up"
+                        )
+                    time.sleep(1.0)
+    except Exception:
+        for p in procs:
+            p.kill()
+        for p in procs:  # reap: no <defunct> children in the launcher
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable (D-state): nothing more to do
+        raise
+    return procs, ports
+
+
+def shutdown_procs(procs) -> None:
+    """Terminate-then-kill-then-reap teardown for spawned servers."""
+    import subprocess
+
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:  # reap the kill too: no <defunct> children left behind
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable (D-state): nothing more to do
+
+
+def spawn_overlap_swarm(
+    repo_root: str, prefix: str, latencies, *, d_model: int = 512,
+    seq: int = 64,
+):
+    """One subprocess ``nop``-expert server per entry of ``latencies``
+    (the per-pool fake-delay WAN proxies) + the matching multi-layer
+    swarm source/config — the ONE definition of the overlap A/B swarm,
+    shared by ``bench.py --overlap-worker`` and the collect-gate overlap
+    smoke so the gate always validates exactly what the bench measures.
+    Returns ``(procs, source, cfg)``; tear down with
+    :func:`shutdown_procs`."""
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmTransformerConfig,
+    )
+
+    procs, ports = spawn_expert_servers(
+        repo_root, prefix, latencies, d_model=d_model
+    )
+    source = StaticExpertSource({
+        f"{prefix}{layer}.{e}": ("127.0.0.1", ports[layer])
+        for layer in range(len(ports)) for e in range(2)
+    })
+    cfg = SwarmTransformerConfig(
+        vocab_size=64, d_model=d_model, n_layers=len(ports), n_heads=8,
+        seq_len=seq, grid_size=(2,), k_best=2, k_min=1, uid_prefix=prefix,
+        timeout_after_k_min=30.0,
+        forward_timeout=120.0, backward_timeout=120.0,
+        # pin the codec: the adaptive selector reads per-pool RTT EMAs
+        # and would change wire precision per schedule arm, breaking the
+        # bitwise-parity contract between serial and overlapped
+        wire_codec="none",
+    )
+    return procs, source, cfg
+
+
 def find_orphan_servers(exclude_descendants_of: Optional[int] = None) -> list:
     """Scan /proc for ``learning_at_home_tpu.server`` processes left over
     from a PRIOR session.  Orphans silently load the (single) core and

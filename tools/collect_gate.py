@@ -148,7 +148,99 @@ def smoke_worker() -> int:
     rc = codec_smoke()
     if rc:
         return rc
-    return telemetry_smoke()
+    rc = telemetry_smoke()
+    if rc:
+        return rc
+    return overlap_smoke()
+
+
+def overlap_smoke() -> int:
+    """Overlap gate (ISSUE 7): a 2-layer swarm forward against two
+    fake-delay pools — SUBPROCESS servers with ~50/60 ms injected chaos
+    reply latency and ``nop`` experts, so the window is pure latency.
+    The overlapped schedule must (a) produce bitwise the same outputs as
+    the serial schedule — same primitive ops, different host-side
+    scheduling — and (b) beat it wall-clock, because each layer's
+    attention now runs inside the in-flight RPC window.
+
+    Subprocess (not in-process) servers are load-bearing: an in-process
+    server shares the client's GIL, and the eager attention the schedule
+    hides starves the server's loop threads — the reply window then
+    GROWS by exactly the hidden compute and the A/B measures nothing
+    (observed 2026-08-04; same reason bench.py's large regimes fork)."""
+    import time
+
+    import numpy as np
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.utils.subproc import (
+        shutdown_procs,
+        spawn_overlap_swarm,
+    )
+
+    try:
+        # the ONE shared swarm definition (utils.subproc): the gate must
+        # validate exactly the swarm bench.py --overlap-worker measures
+        servers, source, cfg = spawn_overlap_swarm(
+            REPO, "ov", (0.05, 0.06)
+        )
+    except Exception as e:
+        print(f"collect_gate: overlap smoke setup failed: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from learning_at_home_tpu.models.transformer_swarm import (
+            SwarmDMoETransformerLM,
+        )
+
+        # one model per arm: fractions must not mix schedules
+        model_s = SwarmDMoETransformerLM(cfg, source)
+        model_o = SwarmDMoETransformerLM(cfg, source)
+        params = model_s.init_params(jax.random.PRNGKey(0))
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 64, (8, cfg.seq_len))
+        )
+
+        def run(model, overlap: bool):
+            t0 = time.monotonic()
+            out = jax.block_until_ready(
+                model.apply_overlapped(params, ids, overlap=overlap)
+            )
+            return time.monotonic() - t0, np.asarray(out)
+
+        run(model_s, False), run(model_o, True)  # warm, unmeasured
+        serial_t, overlap_t = [], []
+        out_s = out_o = None
+        for _ in range(3):  # interleaved pairs: box noise hits both arms
+            dt, out_s = run(model_s, False)
+            serial_t.append(dt)
+            dt, out_o = run(model_o, True)
+            overlap_t.append(dt)
+        s50, o50 = float(np.median(serial_t)), float(np.median(overlap_t))
+        assert np.array_equal(out_s, out_o), (
+            "overlapped schedule changed the forward outputs"
+        )
+        assert o50 < s50, (
+            f"overlapped step not faster: {o50 * 1e3:.1f} ms vs serial "
+            f"{s50 * 1e3:.1f} ms"
+        )
+        frac = max(
+            m.dispatch_stats()["overlap_fraction"] for m in model_o.moes
+        )
+        assert frac > 0.0, "overlap_fraction stayed zero under delays"
+        print(
+            f"overlap step p50: serial {s50 * 1e3:.1f} ms, overlapped "
+            f"{o50 * 1e3:.1f} ms ({o50 / s50:.3f}), overlap_fraction "
+            f"{frac:.3f}"
+        )
+    finally:
+        shutdown_procs(servers)
+        reset_client_rpc()
+    print("OVERLAP_SMOKE_OK schedule=fire/join")
+    return 0
 
 
 def codec_smoke() -> int:
@@ -349,9 +441,9 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # four smokes now (client path, averaging, codec, telemetry+
-            # lah_top subprocess): a wider default bound than the gate's
-            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "540")),
+            # five smokes now (client path, averaging, codec, telemetry+
+            # lah_top subprocess, overlap): a wider bound than the gate's
+            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "600")),
         )
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
@@ -362,6 +454,7 @@ def run_smoke() -> int:
         or "AVG_SMOKE_OK" not in r.stdout
         or "CODEC_SMOKE_OK" not in r.stdout
         or "TELEMETRY_SMOKE_OK" not in r.stdout
+        or "OVERLAP_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
               file=sys.stderr)

@@ -120,7 +120,7 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
         "",
         f"{'PEER':<28} {'ROLE':<8} {'HEALTH':<12} {'JOBS':>8} "
         f"{'QDEPTH':>6} {'OVERLAP':>8} {'PADWASTE':>9} {'DISP':>8} "
-        f"{'AVG(dg/ok)':>11}",
+        f"{'INFLT':>6} {'AVG(dg/ok)':>11}",
     ]
     experts: dict[str, float] = {}
     for row in rows:
@@ -132,13 +132,25 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
         denom = rows_total + padded
         rounds = _num(m.get("lah_averaging_rounds_total"))
         degraded = _num(m.get("lah_averaging_degraded_rounds_total"))
+        # OVERLAP means the peer's own hot-path overlap: servers report
+        # runtime job overlap (dispatch N+1 while N materializes);
+        # trainers report the CLIENT dispatch overlap fraction — how much
+        # in-flight RPC time the overlapped swarm step hid behind trunk
+        # compute (ISSUE 7) — so the dashboard shows who is actually
+        # overlapping on either side of the wire
+        ovl = (
+            overlapped / jobs if jobs
+            else _num(m.get("lah_client_overlap_fraction"))
+        )
+        inflight = int(_num(m.get("lah_client_inflight_dispatches")))
         lines.append(
             f"{row['peer_id']:<28.28} {row['role']:<8.8} "
             f"{peer_health(row):<12} {int(jobs):>8} "
             f"{int(_num(m.get('lah_server_queue_depth'))):>6} "
-            f"{(overlapped / jobs if jobs else 0.0):>8.2f} "
+            f"{ovl:>8.2f} "
             f"{(padded / denom if denom else 0.0):>9.3f} "
             f"{int(_num(m.get('lah_client_dispatches_total'))):>8} "
+            f"{inflight:>6} "
             f"{int(degraded):>5}/{int(rounds):<5}"
         )
         for uid, n in _section(row, "experts").items():
