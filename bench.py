@@ -181,7 +181,7 @@ def run_dispatch_microbench(deadline: int = 600) -> dict | None:
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "b21994a"
+PREV_ROUND_REV = "4064fe4"
 
 
 def check_orphan_servers() -> dict | None:
@@ -358,6 +358,11 @@ def main() -> int:
         ovl = run_overlap_bench()
         if ovl:
             result.update(ovl)
+        # latency-aware routing A/B (ISSUE 8): zipf-skewed gate against
+        # one chaos-slowed pool, cost-model on vs bias=0
+        skw = run_skewed_routing_bench()
+        if skw:
+            result.update(skw)
     if box_dirty:
         result.update(box_dirty)
     print(json.dumps(result), flush=True)
@@ -1335,6 +1340,162 @@ def run_overlap_bench(deadline: int = 420) -> dict | None:
     return None
 
 
+def skewed_routing_worker() -> None:
+    """Skewed-routing A/B (ISSUE 8 acceptance): a zipf-skewed gate over
+    8 experts whose HOT half lives on a chaos-slowed, reply-dropping
+    server, cost-model arm (DEFAULT_COST_WEIGHT) vs bias=0 arm in
+    interleaved pairs.  The blind gate keeps dispatching into injected
+    latency + drops; the cost-aware arm learns the slow pool's RTT EMA
+    (timeouts fold in as latency evidence) and routes the zipf near-ties
+    to the fast pool — dispatch p99 and dropped_fraction are the
+    observables.  The bias=0 arm IS today's selection bitwise
+    (RoutingCostModel returns bias=None at weight 0 — tier-1 asserts
+    the bitwise part; this worker measures the tail)."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ.get("BENCH_DEADLINE_S", "420")), exit=True
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+    from learning_at_home_tpu.client.routing import (
+        DEFAULT_COST_WEIGHT,
+        StaticExpertSource,
+    )
+    from learning_at_home_tpu.server import ChaosConfig
+    from learning_at_home_tpu.server.server import background_server
+
+    hid, rows, n_experts = 32, 64, 8
+    pairs = int(os.environ.get("BENCH_SKEWED_PAIRS", "5"))
+    per_arm = 2
+    slow_chaos = ChaosConfig(
+        base_latency=float(os.environ.get("BENCH_SKEWED_LATENCY", "0.08")),
+        # 0.5 so the blind arm's drops survive the disaggregated-retry
+        # healing inside the short bench window (a retry also has to
+        # fail for a sample to actually drop) — the regime where the
+        # dropped_fraction delta is observable, not just the p99 tail
+        drop_prob=float(os.environ.get("BENCH_SKEWED_DROP", "0.5")),
+        seed=0,
+    )
+    out: dict = {
+        "skewed_rows": rows,
+        "skewed_ab_pairs": pairs,
+        "skewed_chaos_latency_s": slow_chaos.base_latency,
+        "skewed_chaos_drop_prob": slow_chaos.drop_prob,
+        "skewed_cost_weight": DEFAULT_COST_WEIGHT,
+    }
+    # the zipf-HOT experts (0..3) live on the slow server
+    with background_server(
+        num_experts=4, hidden_dim=hid, expert_prefix="skw", seed=1,
+        chaos=slow_chaos, warmup=[rows],
+    ) as (slow_ep, slow_srv):
+        with background_server(
+            num_experts=4, hidden_dim=hid, expert_prefix="skw",
+            expert_offset=4, seed=2, warmup=[rows],
+        ) as (fast_ep, fast_srv):
+            experts = {uid: slow_ep for uid in slow_srv.experts}
+            experts.update({uid: fast_ep for uid in fast_srv.experts})
+            source = StaticExpertSource(experts)
+
+            def make_moe(weight):
+                return RemoteMixtureOfExperts(
+                    in_features=hid, grid_size=(n_experts,),
+                    uid_prefix="skw", source=source, k_best=2, k_min=1,
+                    forward_timeout=3.0, timeout_after_k_min=0.3,
+                    routing_cost_weight=weight,
+                )
+
+            arms = {
+                "cost": make_moe(DEFAULT_COST_WEIGHT),
+                "blind": make_moe(0.0),
+            }
+            # zipf-skewed gate: rank-1 weight row turns x's pinned first
+            # coordinate into per-expert zipf offsets; the remaining
+            # rows add per-sample noise, so near-ties exist for the
+            # bias to resolve
+            rs = np.random.RandomState(0)
+            w0 = rs.randn(hid, n_experts).astype(np.float32) * 0.3
+            zipf = np.log(1.0 / np.arange(1, n_experts + 1) ** 1.1)
+            w0[0, :] = (zipf - zipf.mean()).astype(np.float32) * 2.0
+            gate = {"w0": jnp.asarray(w0)}
+
+            def run(arm: str, n: int) -> None:
+                moe = arms[arm]
+                for i in range(n):
+                    x = rs.randn(rows, hid).astype(np.float32)
+                    x[:, 0] = 1.0  # carries the zipf offsets
+                    jax.block_until_ready(moe(jnp.asarray(x), gate))
+
+            for arm in arms:  # warm: compiles + EMA probes, unmeasured
+                run(arm, 2)
+            # warmup exclusion covers the drop counters too: warm-phase
+            # drops happen before the cost arm has any EMA to act on and
+            # must not dilute the steady-state dropped_fraction delta
+            warm_n = {a: len(arms[a].dispatch_times) for a in arms}
+            warm_s = {
+                a: (arms[a].samples_total, arms[a].samples_dropped)
+                for a in arms
+            }
+            for _ in range(pairs):
+                for arm in ("blind", "cost"):
+                    run(arm, per_arm)
+            for arm, moe in arms.items():
+                t = np.asarray(moe.dispatch_times)[warm_n[arm]:] * 1e3
+                out[f"skewed_dispatch_p50_ms_{arm}"] = round(
+                    float(np.percentile(t, 50)), 2
+                )
+                out[f"skewed_dispatch_p99_ms_{arm}"] = round(
+                    float(np.percentile(t, 99)), 2
+                )
+                out[f"skewed_dropped_fraction_{arm}"] = round(
+                    (moe.samples_dropped - warm_s[arm][1])
+                    / max(moe.samples_total - warm_s[arm][0], 1), 4
+                )
+            out["skewed_p99_cost_vs_blind"] = (
+                round(
+                    out["skewed_dispatch_p99_ms_cost"]
+                    / out["skewed_dispatch_p99_ms_blind"], 3
+                )
+                if out["skewed_dispatch_p99_ms_blind"] else None
+            )
+            out["skewed_bias_applied"] = arms[
+                "cost"
+            ].dispatch_stats()["routing"]["bias_applied"]
+    reset_client_rpc()
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(out), flush=True)
+
+
+def run_skewed_routing_bench(deadline: int = 300) -> dict | None:
+    """Skewed-routing cost-model A/B in a scrubbed CPU subprocess
+    (host/DCN tier, accelerator-independent like the dispatch bench)."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_DEADLINE_S"] = str(deadline)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--skewed-worker"],
+            capture_output=True, text=True, timeout=deadline + 30,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: skewed-routing bench timed out", file=sys.stderr)
+        return None
+    result = _last_json_line(r.stdout)
+    if result is None:
+        print(f"bench: skewed-routing bench rc={r.returncode}, no JSON\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+    return result
+
+
 def averaging_worker() -> None:
     """Trainer-side averaging microbench: two in-process peers run
     ``--avg-rounds`` DHT-matched all-reduce rounds over a trunk-sized
@@ -1439,4 +1600,15 @@ if __name__ == "__main__":
     if "--overlap-worker" in sys.argv:
         overlap_worker()
         sys.exit(0)
+    if "--skewed-worker" in sys.argv:
+        skewed_routing_worker()
+        sys.exit(0)
+    if "--skewed-routing" in sys.argv:
+        # standalone latency-aware-routing A/B (ISSUE 8): just the
+        # zipf-skewed cost-model-vs-blind series, in the same scrubbed
+        # subprocess the full bench uses
+        _skw = run_skewed_routing_bench()
+        print(json.dumps(_skw if _skw else {"error": "skewed bench failed"}),
+              flush=True)
+        sys.exit(0 if _skw else 1)
     sys.exit(main())

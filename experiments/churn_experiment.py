@@ -46,6 +46,15 @@ def parse_args():
                    help="compress activation/grad payloads on the wire")
     p.add_argument("--latency-weight", type=float, default=0.0,
                    help="debit expert selection by endpoint RTT EMA")
+    p.add_argument("--routing-cost-weight", type=float, default=None,
+                   help="latency-aware routing cost-model weight (ISSUE 8); "
+                        "default falls back to --latency-weight")
+    p.add_argument("--replicate-first", type=int, default=0,
+                   help="host the hot expert churn.0 on the first N "
+                        "servers (replica-kill scenario: the schedule's "
+                        "first victim is churn.0's primary, so dispatches "
+                        "must survive via the replica set + hedged "
+                        "fallback; the summary reports hedge fires/wins)")
     p.add_argument("--averaging", action="store_true",
                    help="averaging-under-churn scenario: a companion "
                         "trainer peer averages gate params with this "
@@ -80,15 +89,26 @@ def main():
     bootstrap = DHT()
     env = clean_jax_subprocess_env(REPO)
 
+    def server_uids(v: int) -> set:
+        base = v * args.experts_per_server
+        uids = {f"churn.{i}" for i in range(base, base + args.experts_per_server)}
+        if args.replicate_first and 0 < v < args.replicate_first:
+            # replica-kill scenario: the first N servers ALL host the hot
+            # expert churn.0 (crc32-uid seeding makes every copy start
+            # from identical weights); killing its primary then costs one
+            # hedge window, not availability
+            uids.add("churn.0")
+        return uids
+
     def launch_server(server_idx: int) -> subprocess.Popen:
-        """One server process hosting a contiguous block of the grid."""
+        """One server process hosting a contiguous block of the grid
+        (plus the hot expert's replica when --replicate-first covers it)."""
         log = open(f"/tmp/churn_srv{server_idx}.log", "ab")
         try:
             return subprocess.Popen(
                 [
                     sys.executable, "-m", "learning_at_home_tpu.server",
-                    "--num-experts", str(args.experts_per_server),
-                    "--expert-offset", str(server_idx * args.experts_per_server),
+                    "--expert-uids", ",".join(sorted(server_uids(server_idx))),
                     "--expert-prefix", "churn",
                     "--hidden-dim", str(args.hidden_dim),
                     "--port", str(args.base_port + server_idx),
@@ -105,10 +125,6 @@ def main():
             )
         finally:
             log.close()  # Popen dup'd the fd; don't leak ours
-
-    def server_uids(v: int) -> set:
-        base = v * args.experts_per_server
-        return {f"churn.{i}" for i in range(base, base + args.experts_per_server)}
 
     servers: dict[int, subprocess.Popen] = {}
     client_dht = None
@@ -135,6 +151,7 @@ def main():
             alive_ttl=args.ttl / 2,
             wire_dtype=args.wire_dtype,
             latency_weight=args.latency_weight,
+            routing_cost_weight=args.routing_cost_weight,
         )
         gate = moe.init_gate_params(jax.random.PRNGKey(args.seed))
         opt = optax.adam(1e-2)
@@ -297,12 +314,19 @@ def main():
                 )
 
         p50 = float(np.median(list(moe.dispatch_times)) * 1000)
+        routing = moe.dispatch_stats()["routing"]
         summary = {
             "metric": "churn summary",
             "steps": args.steps,
             "quorum_failures": quorum_failures,
             "quorum_success_rate": round(1 - quorum_failures / args.steps, 4),
             "dispatch_p50_ms": round(p50, 2),
+            "samples_dropped": moe.samples_dropped,
+            # hedged replica dispatch (ISSUE 8): under --replicate-first,
+            # a killed primary should cost hedge windows, not quorums
+            "hedge_fires": routing["hedge_fires"],
+            "hedge_wins": routing["hedge_wins"],
+            "routing_bias_applied": routing["bias_applied"],
         }
         if avg_main is not None:
             s = avg_main.stats()

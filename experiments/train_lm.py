@@ -139,6 +139,12 @@ def parse_args():
     p.add_argument("--latency-weight", type=float, default=0.0,
                    help="swarm mode: debit expert selection scores by this "
                         "x endpoint RTT EMA (s) — route around slow peers")
+    p.add_argument("--routing-cost-weight", type=float, default=None,
+                   help="swarm mode: latency-aware routing cost model "
+                        "weight (RTT EMA + advertised queue depth + "
+                        "estimated transfer, min over replicas; ISSUE 8). "
+                        "0 = off (bias=None, blind-gate selection); "
+                        "default: fall back to --latency-weight")
     p.add_argument("--telemetry-prefix", default="swarm",
                    help="swarm mode: advertise this trainer's metrics "
                         "endpoint under telemetry.<prefix> in the DHT "
@@ -484,6 +490,8 @@ def run_swarm(args):
         wire_dtype=args.wire_dtype,
         wire_codec=args.wire_codec,
         latency_weight=args.latency_weight,
+        routing_cost_weight=args.routing_cost_weight,
+        telemetry_prefix=args.telemetry_prefix,
     )
     model = SwarmDMoETransformerLM(cfg, client_dht)
     params = model.init_params(jax.random.PRNGKey(args.seed))
@@ -819,6 +827,8 @@ def run_multi_trainer(args):
             base += ["--wire-codec", args.wire_codec]
         if args.latency_weight:
             base += ["--latency-weight", str(args.latency_weight)]
+        if args.routing_cost_weight is not None:
+            base += ["--routing-cost-weight", str(args.routing_cost_weight)]
         if args.checkpoint_every:
             base += ["--checkpoint-every", str(args.checkpoint_every)]
         for t in range(args.n_trainers):

@@ -43,6 +43,9 @@ from jax.experimental import io_callback
 from learning_at_home_tpu.client.routing import (
     CachedAliveSet,
     ExpertSource,
+    ReplicaSet,
+    RoutingCostModel,
+    as_replica_set,
     beam_search_alive,
     filter_valid_uids,
     select_top_k,
@@ -111,6 +114,10 @@ class RemoteMixtureOfExperts:
         wire_dtype: Optional[str] = None,
         wire_codec: Optional[str] = None,
         latency_weight: float = 0.0,
+        routing_cost_weight: Optional[float] = None,
+        telemetry_prefix: str = "swarm",
+        hedge_mult: Optional[float] = None,
+        hedge_floor_s: Optional[float] = None,
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
@@ -175,16 +182,70 @@ class RemoteMixtureOfExperts:
         # per-codec payload counts (plain int adds on the host thread;
         # scrape readers copy-with-retry like the deques)
         self.codec_counts: dict[str, int] = {}
-        # latency-aware SELECTION (topology/load-aware routing, cf. the
-        # TA-MoE / MoETuner line of work): each expert's selection score
-        # is debited latency_weight × its endpoint's RTT EMA (seconds —
-        # network + peer queueing + compute, from ConnectionPool), so
-        # near-tied gate scores resolve toward fast/unloaded peers
-        # PROACTIVELY instead of only dropping stragglers reactively via
-        # the quorum.  Combine weights stay clean-gate (selection-only,
-        # like router jitter).  0.0 = off (exact reference semantics);
-        # gate logits are O(1), so e.g. 5.0 makes 100 ms cost 0.5 logits.
-        self.latency_weight = latency_weight
+        # latency-aware SELECTION (ISSUE 8; cf. TA-MoE / MoETuner): the
+        # RoutingCostModel debits each expert's selection score by
+        # ``weight × predicted completion time`` — pool RTT EMA + the
+        # peer's DHT-advertised queue depth + estimated transfer time at
+        # the negotiated codec, minimized over the uid's replica set.
+        # Combine weights stay clean-gate (selection-only, like router
+        # jitter).  Weight resolution: LAH_ROUTING_COST_WEIGHT env >
+        # ``routing_cost_weight`` ctor > the historical ``latency_weight``
+        # alias (whose rtt-only behavior the model reproduces bitwise
+        # when no load feed or bandwidth measurement exists).  0 = off:
+        # bias is None and selection is bitwise today's blind gate.
+        env_w = os.environ.get("LAH_ROUTING_COST_WEIGHT")
+        if env_w not in (None, ""):
+            cost_weight = float(env_w)
+        elif routing_cost_weight is not None:
+            cost_weight = float(routing_cost_weight)
+        else:
+            cost_weight = float(latency_weight)
+        self.latency_weight = cost_weight  # historical alias, kept readable
+        self.telemetry_prefix = telemetry_prefix
+        load_getter = (
+            self._make_load_getter(source, telemetry_prefix)
+            if hasattr(source, "get") and hasattr(source, "declare_experts")
+            else None
+        )
+        from learning_at_home_tpu.utils.serialization import CODEC_WIRE_RATIO
+
+        self.cost_model = RoutingCostModel(
+            cost_weight,
+            load_getter=load_getter,
+            load_ttl=alive_ttl,
+            codec_ratio=CODEC_WIRE_RATIO.get(self.wire_codec or "", 1.0),
+        )
+        # hedged replica dispatch (ISSUE 8): once a forward fan-out call
+        # to a replicated expert outlives ``hedge_mult × the primary
+        # pool's RTT EMA`` (floored at hedge_floor_s), the SAME prepared
+        # payload is fired at the backup replica and the first successful
+        # reply wins — a dying primary costs one hedge window, not a
+        # quorum timeout.  mult ≤ 0 disables hedging entirely; backward
+        # fan-outs never hedge (the optimizer step is a side effect — a
+        # duplicate would apply the same gradients twice).
+        if hedge_mult is None:
+            try:
+                hedge_mult = float(os.environ.get("LAH_HEDGE_MULT", "3"))
+            except ValueError:
+                hedge_mult = 3.0
+        if hedge_floor_s is None:
+            try:
+                hedge_floor_s = float(
+                    os.environ.get("LAH_HEDGE_MIN_S", "0.05")
+                )
+            except ValueError:
+                hedge_floor_s = 0.05
+        self.hedge_mult = hedge_mult
+        self.hedge_floor_s = hedge_floor_s
+        # hedge counters are owned by the lah-client LOOP thread (armed
+        # and resolved inside the fan-out coroutine); scrape readers take
+        # plain int snapshots — no lock on either side
+        self.hedge_fires = 0
+        self.hedge_wins = 0
+        self.hedges_skipped = 0
+        # replica observability: uid → replica count from the latest
+        # alive-set resolution (host-thread writes, copy-on-read scrapes)
+        self._replica_counts: dict[str, int] = {}
         self.source = source
         self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
         self._sessions: OrderedDict[int, dict] = OrderedDict()
@@ -250,6 +311,31 @@ class RemoteMixtureOfExperts:
             return None if moe is None else moe._headline_metrics()
 
         _registry.register_collector(f"moe-{id(self)}", _collect)
+
+    @staticmethod
+    def _make_load_getter(source, prefix: str):
+        """TTL-refreshed ``host:port`` → load-record map from the DHT's
+        ``load.<prefix>`` heartbeats (utils/telemetry.py).  Called by the
+        cost model on the dispatching HOST thread at most once per TTL
+        window — one bounded control-plane loop round-trip, mirroring the
+        alive-set cache's refresh discipline."""
+
+        def _get() -> dict:
+            from learning_at_home_tpu.utils.telemetry import (
+                load_key,
+                parse_load_value,
+            )
+
+            records = client_loop().run(source.get(load_key(prefix)))
+            out = {}
+            for subkey, entry in records.items():
+                value = entry[0] if isinstance(entry, (tuple, list)) else entry
+                parsed = parse_load_value(value)
+                if isinstance(subkey, str) and parsed is not None:
+                    out[subkey] = parsed
+            return out
+
+        return _get
 
     # ---- gate parameters ----
 
@@ -487,18 +573,30 @@ class RemoteMixtureOfExperts:
                 alive_uids = sorted(
                     filter_valid_uids(alive, self.uid_prefix, self.grid_size)
                 )
+            # replica-aware resolution: each uid's alive-map value may be
+            # a single endpoint (the historical form) or a DHT-advertised
+            # replica SET; the cost model orders every set cheapest-first,
+            # so entry 0 is the least-loaded primary and entry 1 the
+            # hedge backup
+            replica_sets: dict[str, ReplicaSet] = {
+                uid: self.cost_model.order_replicas(
+                    as_replica_set(alive[uid]), nbytes=x.nbytes
+                )
+                for uid in alive_uids
+            }
+            alive_uids = [uid for uid in alive_uids if replica_sets[uid]]
             if not alive_uids:
                 raise MoEDispatchError(
                     f"no alive experts under prefix {self.uid_prefix!r}"
                 )
-            bias = None
-            if self.latency_weight:
-                registry = pool_registry()
-                bias = np.zeros(len(alive_uids), np.float32)
-                for j, uid in enumerate(alive_uids):
-                    pool = registry.peek(alive[uid])  # non-creating: see peek()
-                    if pool is not None and pool.rtt_ema is not None:
-                        bias[j] = -self.latency_weight * pool.rtt_ema
+            self._replica_counts = {
+                uid: len(replica_sets[uid]) for uid in alive_uids
+            }
+            # latency-aware selection bias (None at weight 0 → bitwise
+            # the blind gate); combine weights stay clean-gate
+            bias = self.cost_model.bias(
+                alive_uids, replica_sets, nbytes=x.nbytes
+            )
             sel, coords = select_top_k(
                 logits, alive_uids, self.k_best, bias=bias
             )  # [B, k']
@@ -522,6 +620,16 @@ class RemoteMixtureOfExperts:
                     else:
                         jobs[e] = (rows, np.full(len(rows), j))
 
+            # least-loaded replica pick: the job targets the cheapest
+            # replica; the second-cheapest (if any) rides along as the
+            # hedge backup for the fan-out's hedged fallback
+            backups: dict[str, Optional[tuple]] = {
+                alive_uids[e]: (
+                    replica_sets[alive_uids[e]][1]
+                    if len(replica_sets[alive_uids[e]]) > 1 else None
+                )
+                for e in jobs
+            }
             prepared = None
             if dispatch_mode() == "pipelined":
                 # payload slot left empty: _prepare_payloads slices each
@@ -530,7 +638,9 @@ class RemoteMixtureOfExperts:
                 uid_jobs, prepared = self._prepare_payloads(
                     "forward",
                     {
-                        alive_uids[e]: (alive[alive_uids[e]], None, rows, slots)
+                        alive_uids[e]: (
+                            replica_sets[alive_uids[e]][0], None, rows, slots
+                        )
                         for e, (rows, slots) in jobs.items()
                     },
                     x_full=x,
@@ -538,7 +648,9 @@ class RemoteMixtureOfExperts:
                 )
             else:
                 uid_jobs = {
-                    alive_uids[e]: (alive[alive_uids[e]], x[rows], rows, slots)
+                    alive_uids[e]: (
+                        replica_sets[alive_uids[e]][0], x[rows], rows, slots
+                    )
                     for e, (rows, slots) in jobs.items()
                 }
 
@@ -550,6 +662,9 @@ class RemoteMixtureOfExperts:
             rpc_timeout=self.forward_timeout,
             prepared=prepared,
             trace=trace,
+            # hedging is a pipelined-path behavior: the legacy arm stays
+            # the exact pre-replica A/B baseline
+            backups=backups if dispatch_mode() == "pipelined" else None,
         )
 
         fut_box: list = []
@@ -937,11 +1052,24 @@ class RemoteMixtureOfExperts:
             max(0.0, min(1.0, 1.0 - blocked_s / inflight_s))
             if inflight_s > 0 else 0.0
         )
+        replica_counts = self._snap_replica_counts()
+        replicated = sum(1 for n in replica_counts.values() if n > 1)
         return {
             **{
                 f"lah_client_wire_codec_payloads_total_codec_{c}": n
                 for c, n in codec_counts.items()
             },
+            # latency-aware routing + hedged replica dispatch (ISSUE 8)
+            "lah_client_routing_bias_applied_total": (
+                self.cost_model.bias_applied
+            ),
+            "lah_client_hedge_fires_total": self.hedge_fires,
+            "lah_client_hedge_wins_total": self.hedge_wins,
+            "lah_client_hedges_skipped_total": self.hedges_skipped,
+            "lah_client_replicated_experts": replicated,
+            "lah_client_replicas_max": max(
+                replica_counts.values(), default=0
+            ),
             "lah_client_overlap_fraction": round(overlap, 4),
             "lah_client_inflight_dispatches": self.inflight_dispatches,
             "lah_client_inflight_seconds_total": round(inflight_s, 3),
@@ -1000,6 +1128,26 @@ class RemoteMixtureOfExperts:
             # insert of a new codec key must not crash on "dict changed
             # size during iteration"
             "codecs": self._snap_codec_counts(),
+            # latency-aware routing + replica/hedge observability
+            # (ISSUE 8): what the cost model actually did this run
+            "routing": {
+                "cost_weight": self.cost_model.weight,
+                "bias_applied": int(
+                    m["lah_client_routing_bias_applied_total"]
+                ),
+                "load_refresh_failures": (
+                    self.cost_model.load_refresh_failures
+                ),
+                "hedge_fires": int(m["lah_client_hedge_fires_total"]),
+                "hedge_wins": int(m["lah_client_hedge_wins_total"]),
+                "hedges_skipped": int(
+                    m["lah_client_hedges_skipped_total"]
+                ),
+                "replicated_experts": int(
+                    m["lah_client_replicated_experts"]
+                ),
+                "replica_counts": self._snap_replica_counts(),
+            },
         }
 
     def _snap_codec_counts(self) -> dict:
@@ -1009,6 +1157,36 @@ class RemoteMixtureOfExperts:
             except RuntimeError:
                 continue
         return {}
+
+    def _snap_replica_counts(self) -> dict:
+        # copy-with-retry: the host thread replaces this dict wholesale
+        # per dispatch; a scrape racing the swap must never crash
+        for _ in range(4):
+            try:
+                return dict(self._replica_counts)
+            except RuntimeError:
+                continue
+        return {}
+
+    # ---- hedge accounting (owned by the lah-client LOOP thread: armed
+    #      and resolved inside the fan-out coroutine — docs/CONCURRENCY.md
+    #      invariant 9; no locks, scrapes read plain-int snapshots) ----
+
+    @sanitizer.runs_on("not:lah-runtime", site="moe.hedge_arm")
+    def _arm_hedge(self, primary, backup) -> None:
+        """Hedge-fire entry point: the primary outlived its RTT-derived
+        deadline (or failed) and the backup replica is being dispatched."""
+        self.hedge_fires += 1
+        timeline.count("client.hedge.fires")
+        logger.debug("hedge fired: primary %s → backup %s", primary, backup)
+
+    @sanitizer.runs_on("not:lah-runtime", site="moe.hedge_arm")
+    def _hedge_skipped(self, backup) -> None:
+        """A due hedge NOT fired: the backup pool cannot accept the
+        prepared wire form (codec never negotiated) — counted, never
+        silently dropped."""
+        self.hedges_skipped += 1
+        timeline.count("client.hedge.skipped")
 
     # ---- host side: backward fan-out to exactly the responders ----
 
@@ -1352,11 +1530,25 @@ class RemoteMixtureOfExperts:
     async def _quorum_fanout(
         self, msg_type: str, jobs: dict, batch: int, quorum: int,
         rpc_timeout: float, prepared: Optional[dict] = None,
-        trace: Optional[str] = None,
+        trace: Optional[str] = None, backups: Optional[dict] = None,
     ) -> dict:
         """Run the fan-out in parallel; once every sample has ≥ quorum
         successful replies, wait a grace period then cancel stragglers (the
         reference's k_min + timeout_after_k_min contract).
+
+        ``backups`` (uid → backup replica endpoint or None; FORWARD only)
+        arms hedged fallback per group: once the primary's call outlives
+        ``hedge_mult × its RTT EMA`` (floor ``hedge_floor_s``) — or fails
+        outright — the SAME prepared payload fires at the backup replica
+        and the first successful reply wins.  Cancel semantics
+        (docs/PROTOCOL.md): a primary that lost to its hedge is cancelled
+        WITH ``QUORUM_STRAGGLER_CANCEL`` (it exceeded the hedge deadline,
+        so its elapsed wait folds into its RTT EMA), while a backup that
+        lost the race is cancelled UNMARKED — its short unfinished wait
+        is evidence about the race, not the peer, and must never reach
+        the EMA.  Backward fan-outs never hedge: the server-side
+        optimizer step is a side effect a duplicate request would apply
+        twice (same reasoning as the no-retry rule below).
 
         Jobs for experts co-hosted on ONE endpoint travel as a single
         ``multi`` request (per-part replies) — per-request overhead is paid
@@ -1510,8 +1702,134 @@ class RemoteMixtureOfExperts:
                 )
             return out
 
+        # ---- hedged replica fallback (ISSUE 8; forward only) ----
+
+        def _cancel_with(task, e: asyncio.CancelledError) -> None:
+            """Forward an outer cancellation (quorum straggler marker or
+            unmarked teardown) to a hedge leg unchanged, so the pool's
+            RTT-EMA marker semantics survive the extra wrapper layer."""
+            if task is not None and not task.done():
+                msg = e.args[0] if e.args else None
+                if msg is not None:
+                    task.cancel(msg=msg)
+                else:
+                    task.cancel()
+
+        def _hedge_delay(endpoint) -> Optional[float]:
+            """RTT-EMA-derived hedge deadline for one primary; None (no
+            timed hedge, fast-failure failover only) until the pool has
+            any latency measurement to scale from."""
+            pool = registry.peek(endpoint)
+            if pool is None or pool.rtt_ema is None:
+                return None
+            return max(self.hedge_mult * pool.rtt_ema, self.hedge_floor_s)
+
+        async def _hedge_wire_ok(backup_ep, uids) -> bool:
+            """The hedge resends the SAME prepared bytes; a quantized
+            (dict-form) payload needs the backup pool to have negotiated
+            the ``codec`` feature — re-encoding on this loop is exactly
+            what the pack-once contract forbids."""
+            if prepared is None:
+                return True
+            if not any(isinstance(prepared[u][1], dict) for u in uids):
+                return True
+            pool = registry.get(backup_ep)
+            try:
+                await pool.ensure_negotiated(timeout=min(rpc_timeout, 5.0))
+            except Exception:
+                return False
+            return pool.supports("codec")
+
+        def _common_backup(uids):
+            """The group's backup endpoint: hedging is per fate-shared
+            group, so all its uids must agree on one backup replica host
+            (disaggregated retries are single-uid groups and always
+            qualify when a backup exists)."""
+            if backups is None or msg_type != "forward" or self.hedge_mult <= 0:
+                return None
+            eps = {backups.get(uid) for uid in uids}
+            backup = eps.pop() if len(eps) == 1 else None
+            return backup
+
+        async def run_group(endpoint, uids) -> tuple[dict, tuple]:
+            """One group's exchange with hedged fallback.  Returns
+            ``(uid → reply tensors, winner endpoint)`` — the winner is
+            what the backward session must target."""
+            t1 = asyncio.ensure_future(call_group(endpoint, uids))
+            backup = _common_backup(uids)
+            if backup is None:
+                try:
+                    return await t1, endpoint
+                except asyncio.CancelledError as e:
+                    _cancel_with(t1, e)
+                    raise
+            t2 = None
+            try:
+                primary_exc = None
+                await asyncio.wait({t1}, timeout=_hedge_delay(endpoint))
+                if t1.done():
+                    primary_exc = t1.exception()
+                    if primary_exc is None:
+                        # awaiting a finished task yields its result
+                        # without touching the loop (lint-clean R2 form)
+                        return await t1, endpoint
+                # the primary exceeded its hedge deadline (or failed
+                # outright): fire the backup replica, first reply wins
+                if not await _hedge_wire_ok(backup, uids):
+                    self._hedge_skipped(backup)
+                    if primary_exc is not None:
+                        raise primary_exc
+                    return await t1, endpoint
+                self._arm_hedge(endpoint, backup)
+                t2 = asyncio.ensure_future(call_group(backup, uids))
+                racing = {t2} if primary_exc is not None else {t1, t2}
+                last_exc = primary_exc
+                while racing:
+                    done, racing = await asyncio.wait(
+                        racing, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    winner = next(
+                        (
+                            t for t in done
+                            if not t.cancelled() and t.exception() is None
+                        ),
+                        None,
+                    )
+                    if winner is t2:
+                        # first-reply-wins, backup took it: cancel the
+                        # loser primary WITH the straggler marker — it
+                        # exceeded its hedge deadline, so the elapsed
+                        # wait IS slowness evidence for its RTT EMA
+                        self.hedge_wins += 1
+                        if not t1.done():
+                            t1.cancel(msg=QUORUM_STRAGGLER_CANCEL)
+                        return await t2, backup
+                    if winner is t1:
+                        # the primary answered after the hedge fired:
+                        # cancel the loser backup UNMARKED — its short
+                        # unfinished wait says nothing about the peer
+                        # and must not poison its RTT EMA
+                        if not t2.done():
+                            t2.cancel()
+                        return await t1, endpoint
+                    for t in done:
+                        if not t.cancelled() and t.exception() is not None:
+                            last_exc = t.exception()
+                if last_exc is not None:
+                    raise last_exc
+                raise RemoteCallError(
+                    f"{endpoint}: hedged {msg_type} group failed"
+                )
+            except asyncio.CancelledError as e:
+                # outer cancel (quorum grace / teardown): forward the
+                # SAME marker to both legs so straggler evidence folds
+                # exactly as it would without the hedge layer
+                _cancel_with(t1, e)
+                _cancel_with(t2, e)
+                raise
+
         pending = {
-            asyncio.ensure_future(call_group(ep, uids)): (ep, uids)
+            asyncio.ensure_future(run_group(ep, uids)): (ep, uids)
             for ep, uids in group_list
         }
         retried: set = set()  # endpoints whose merged call was disaggregated
@@ -1531,7 +1849,7 @@ class RemoteMixtureOfExperts:
                 try:
                     # lah-lint: ignore[R2] task came out of asyncio.wait's
                     # done set — result() on a finished Task never blocks
-                    group_replies = task.result()
+                    group_replies, winner_ep = task.result()
                 except Exception as e:
                     logger.warning(
                         "%s RPC to %s (%d experts) failed: %s: %s",
@@ -1554,8 +1872,13 @@ class RemoteMixtureOfExperts:
                     ):
                         retried.add(endpoint)
                         for uid in uids:
+                            # run_group so each retried single keeps its
+                            # hedge backup (a merged-call failure is often
+                            # the dying-primary case hedging exists for)
                             pending[
-                                asyncio.ensure_future(call_single(endpoint, uid))
+                                asyncio.ensure_future(
+                                    run_group(endpoint, [uid])
+                                )
                             ] = (endpoint, [uid])
                     continue
                 for uid in uids:
@@ -1575,7 +1898,10 @@ class RemoteMixtureOfExperts:
                             len(rows_of[uid]),
                         )
                         continue
-                    results[uid] = (*jobs[uid], tensors)
+                    # the WINNER endpoint replaces the job's primary so
+                    # the backward session targets the replica that
+                    # actually evaluated this forward
+                    results[uid] = (winner_ep, *jobs[uid][1:], tensors)
                     per_sample[rows_of[uid]] += 1
             if deadline is None:
                 # arm the grace period once every sample is either quorate

@@ -14,14 +14,61 @@ multi-dimensional gating.
 from __future__ import annotations
 
 import asyncio
+import logging
+import os
 import time
-from typing import Iterable, Optional, Protocol, Sequence
+from typing import Callable, Iterable, Optional, Protocol, Sequence
 
 import numpy as np
 
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.connection import Endpoint
 
+logger = logging.getLogger(__name__)
+
 UID_DELIMITER = "."
+
+# A replica set: every endpoint currently hosting one expert uid, in a
+# deterministic order.  Alive-map values are EITHER a bare (host, port)
+# endpoint (single-hoster uid — the historical form every existing
+# consumer understands) OR a tuple of endpoints once an expert gained
+# DHT-advertised replicas; ``as_replica_set`` normalizes both.
+ReplicaSet = tuple[Endpoint, ...]
+
+
+def as_replica_set(value) -> ReplicaSet:
+    """Normalize an alive-map value to a tuple of endpoints.
+
+    ``("10.0.0.1", 9000)`` → a 1-tuple; an iterable of endpoints passes
+    through deduplicated with order preserved (the resolver's order is
+    deterministic, so two clients see the same replica list).  Malformed
+    entries inside a set are dropped rather than raised — alive maps are
+    peer-supplied."""
+    if (
+        isinstance(value, (tuple, list))
+        and len(value) == 2
+        and isinstance(value[0], str)
+        and not isinstance(value[1], (tuple, list))
+    ):
+        return ((value[0], int(value[1])),)
+    out: list[Endpoint] = []
+    seen = set()
+    for ep in value:
+        try:
+            ep = (ep[0], int(ep[1]))
+        except (TypeError, ValueError, IndexError):
+            continue
+        if not isinstance(ep[0], str) or ep in seen:
+            continue
+        seen.add(ep)
+        out.append(ep)
+    return tuple(out)
+
+
+def endpoint_key(endpoint: Endpoint) -> str:
+    """The ``host:port`` string form used as DHT subkey for per-endpoint
+    records (replica advertisement, load heartbeats)."""
+    return f"{endpoint[0]}:{endpoint[1]}"
 
 
 def make_uid(prefix: str, coords: Sequence[int]) -> str:
@@ -310,3 +357,180 @@ def select_top_k(
     order = np.take_along_axis(scores, part, axis=1).argsort(axis=1)[:, ::-1]
     sel = np.take_along_axis(part, order, axis=1)
     return sel, coords
+
+
+# --------------------------------------------------------------------------
+# latency-aware routing (ISSUE 8): predicted-completion-time cost model
+# --------------------------------------------------------------------------
+
+# Default selection-bias strength when latency-aware routing is enabled
+# without an explicit weight (gate logits are O(1), so 5.0 makes a 100 ms
+# predicted cost worth 0.5 logits — enough to flip near-ties, never enough
+# to override a decisive gate preference).
+DEFAULT_COST_WEIGHT = 5.0
+
+
+class RoutingCostModel:
+    """Scores alive experts by PREDICTED COMPLETION TIME and turns the
+    prediction into a ``select_top_k(bias=...)`` penalty (cf. TA-MoE's
+    topology-aware dispatch and MoETuner's placement-aware routing).
+
+    Per endpoint, predicted cost (seconds) =
+
+    - the pool's whole-exchange **RTT EMA** (network + peer queueing +
+      compute — ``ConnectionPool.rtt_ema``), plus
+    - **queue-depth cost**: the peer's DHT-advertised runtime queue depth
+      (``load.<prefix>`` heartbeats, utils/telemetry.py) ×
+      ``queue_cost_s`` per queued batch, plus
+    - **estimated transfer time** of this dispatch's payload at the
+      negotiated codec: encoded bytes / the pool's measured bytes-per-sec
+      EMA (``bw_ema``; pools without a large-exchange measurement pay no
+      transfer term rather than a guessed one).
+
+    A uid's cost is the MINIMUM over its replica set (the dispatch will
+    pick that cheapest replica), and endpoints with no signal at all cost
+    0.0 — unmeasured peers stay attractive (exploration), exactly the old
+    ``latency_weight`` semantics, so ``weight == latency_weight`` with no
+    load feed and no bw measurement reproduces the historical bias
+    bitwise.  ``weight == 0`` returns ``bias=None``: selection is then
+    bitwise identical to the blind gate (the A/B contract).
+
+    All lookups are plain dict/attribute reads on the calling host
+    thread; the only I/O is the TTL-gated ``load_getter`` refresh (a
+    bounded control-plane DHT read, mirroring the alive-set cache).
+    """
+
+    def __init__(
+        self,
+        weight: float = 0.0,
+        *,
+        registry=None,
+        load_getter: Optional[Callable[[], dict]] = None,
+        load_ttl: float = 3.0,
+        queue_cost_s: Optional[float] = None,
+        codec_ratio: float = 1.0,
+    ):
+        self.weight = float(weight)
+        self._registry = registry
+        self._load_getter = load_getter
+        self.load_ttl = load_ttl
+        if queue_cost_s is None:
+            try:
+                queue_cost_s = float(
+                    os.environ.get("LAH_ROUTING_QUEUE_COST_S", "0.005")
+                )
+            except ValueError:
+                queue_cost_s = 0.005
+        self.queue_cost_s = queue_cost_s
+        # wire-bytes multiplier of the codec the dispatch will negotiate
+        # (0.25 for the 8-bit codecs, 0.5 for bf16, 1.0 raw)
+        self.codec_ratio = codec_ratio
+        self._loads: dict = {}
+        self._loads_stamp = 0.0
+        # observability: how many bias computations actually had signal
+        self.bias_applied = 0
+        self.load_refresh_failures = 0
+
+    def _pools(self):
+        if self._registry is not None:
+            return self._registry
+        from learning_at_home_tpu.client.rpc import pool_registry
+
+        return pool_registry()
+
+    def loads(self) -> dict:
+        """endpoint-key ("host:port") → load record, TTL-refreshed via
+        the getter (best-effort: a failed refresh keeps the stale map for
+        one window and counts the failure)."""
+        if self._load_getter is None:
+            return self._loads
+        now = time.monotonic()
+        if now - self._loads_stamp > self.load_ttl:
+            self._loads_stamp = now  # stamp first: one refresh per window
+            try:
+                loads = self._load_getter()
+                self._loads = loads if isinstance(loads, dict) else {}
+            except Exception as e:
+                self.load_refresh_failures += 1
+                logger.debug("routing load refresh failed: %s: %s",
+                             type(e).__name__, e)
+        return self._loads
+
+    def queue_depth(self, endpoint: Endpoint) -> Optional[float]:
+        rec = self.loads().get(endpoint_key(endpoint))
+        if isinstance(rec, dict):
+            try:
+                return float(rec.get("q"))
+            except (TypeError, ValueError):
+                return None
+        return None
+
+    def predicted_cost_s(
+        self, endpoint: Endpoint, nbytes: int = 0
+    ) -> Optional[float]:
+        """Predicted completion time for one dispatch to ``endpoint``;
+        None when there is NO signal (never contacted, no load record) —
+        the caller treats that as cost 0 (optimistic exploration)."""
+        pool = self._pools().peek(endpoint)
+        rtt = pool.rtt_ema if pool is not None else None
+        q = self.queue_depth(endpoint)
+        transfer = None
+        if (
+            nbytes > 0
+            and pool is not None
+            and pool.bw_ema is not None
+            and pool.bw_ema > 0
+        ):
+            transfer = (nbytes * self.codec_ratio) / pool.bw_ema
+        if rtt is None and q is None and transfer is None:
+            return None
+        return (
+            (rtt or 0.0)
+            + (q or 0.0) * self.queue_cost_s
+            + (transfer or 0.0)
+        )
+
+    def order_replicas(
+        self, replicas: ReplicaSet, nbytes: int = 0
+    ) -> ReplicaSet:
+        """Replica set sorted cheapest-first (the least-loaded pick; the
+        second entry is the hedge backup).  Unmeasured replicas cost 0 —
+        an unknown peer outranks a known-slow one — and exact ties break
+        on the endpoint itself, so the order is deterministic."""
+        if len(replicas) <= 1:
+            return replicas
+        return tuple(
+            sorted(
+                replicas,
+                key=lambda ep: (self.predicted_cost_s(ep, nbytes) or 0.0, ep),
+            )
+        )
+
+    @sanitizer.runs_on("host", site="routing.cost_bias")
+    def bias(
+        self,
+        alive_uids: Sequence[str],
+        replica_sets: dict,
+        nbytes: int = 0,
+    ) -> Optional[np.ndarray]:
+        """The ``select_top_k`` bias vector: ``-weight × min-over-replica
+        predicted cost`` per uid.  None when the weight is 0 (bias=None →
+        selection bitwise identical to today's blind gate) or when no
+        endpoint has any signal yet."""
+        if not self.weight:
+            return None
+        bias = np.zeros(len(alive_uids), np.float32)
+        any_signal = False
+        for j, uid in enumerate(alive_uids):
+            best = None
+            for ep in replica_sets[uid]:
+                cost = self.predicted_cost_s(ep, nbytes)
+                if cost is not None and (best is None or cost < best):
+                    best = cost
+            if best is not None:
+                bias[j] = -self.weight * best
+                any_signal = True
+        if not any_signal:
+            return None
+        self.bias_applied += 1
+        return bias

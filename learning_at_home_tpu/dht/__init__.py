@@ -8,11 +8,22 @@ separate *process* bridged by mp.Pipe; here the node lives on a dedicated
 asyncio thread (BackgroundLoop) — the async API is callable from ANY loop
 or thread, and sync wrappers serve scripts.
 
-Expert-record layout (powers both enumeration and prefix beam search):
+Expert-record layout (powers enumeration, prefix beam search AND dynamic
+replication — ISSUE 8).  Subkeys are REPLICA-AWARE: two servers declaring
+the same uid land on distinct subkeys instead of clobbering each other,
+and readers aggregate per-uid endpoint SETS:
 
-- full record:   key = uid ("ffn.4.17"),       subkey = "" → [host, port]
-- prefix record: key = each uid prefix ("ffn", "ffn.4"), subkey = uid
+- full record:   key = uid ("ffn.4.17"),  subkey = "@host:port"
                  → [host, port]
+- prefix record: key = each uid prefix ("ffn", "ffn.4"),
+                 subkey = "uid@host:port" → [host, port]
+
+Legacy records (subkey "" for full records, bare-uid subkeys for prefix
+records) are still read as single-replica entries, so mixed-build swarms
+resolve correctly.  ``get_alive_experts`` values are a bare endpoint for
+single-hoster uids (the historical form every consumer understands) and
+a tuple of endpoints once a uid has replicas — clients normalize with
+``client.routing.as_replica_set``.
 
 All records share one expiration; servers re-declare every
 ``update_period`` (heartbeat), so expiry = failure detection.
@@ -114,15 +125,26 @@ class DHT:
         Prefix records are grouped by key: one iterative lookup + one
         batched store per distinct prefix, not one per (uid, prefix) — for
         a 256-expert server the heartbeat is a handful of lookups, not
-        hundreds."""
+        hundreds.
+
+        Subkeys carry the declaring endpoint (replica-aware scheme, see
+        module docstring): N servers hosting one uid coexist as N subkey
+        records under the same keys, each expiring on its own heartbeat —
+        a dead replica vanishes without taking the uid down."""
         expires_at = get_dht_time() + expiration
         value = [endpoint[0], int(endpoint[1])]
+        ep_key = f"{endpoint[0]}:{int(endpoint[1])}"
         by_prefix: dict[str, list] = {}
         for uid in uids:
             for prefix in uid_prefixes(uid):
-                by_prefix.setdefault(prefix, []).append((uid, value, expires_at))
+                by_prefix.setdefault(prefix, []).append(
+                    (f"{uid}@{ep_key}", value, expires_at)
+                )
         results = await asyncio.gather(
-            *(self.node.store(uid, value, expires_at) for uid in uids),
+            *(
+                self.node.store(uid, value, expires_at, f"@{ep_key}")
+                for uid in uids
+            ),
             *(
                 self.node.store_batch(prefix, entries)
                 for prefix, entries in by_prefix.items()
@@ -167,11 +189,22 @@ class DHT:
             return None
 
     async def _get_experts(self, uids) -> dict[str, Optional[Endpoint]]:
+        """Single-endpoint resolution (RemoteExpert's contract): for a
+        replicated uid the first replica in deterministic (sorted-subkey)
+        order is returned — callers that want the full set use
+        ``get_alive_experts`` on the uid's prefix."""
         records = await asyncio.gather(*(self.node.get(uid) for uid in uids))
         out: dict[str, Optional[Endpoint]] = {}
         for uid, rec in zip(uids, records):
-            entry = rec.get(PLAIN_SUBKEY)
-            out[uid] = self._parse_endpoint(entry[0]) if entry else None
+            out[uid] = None
+            for subkey in sorted(rec, key=str):
+                if subkey == PLAIN_SUBKEY or (
+                    isinstance(subkey, str) and subkey.startswith("@")
+                ):
+                    endpoint = self._parse_endpoint(rec[subkey][0])
+                    if endpoint is not None:
+                        out[uid] = endpoint
+                        break
         return out
 
     # ---- ExpertSource protocol (used by RemoteMixtureOfExperts) ----
@@ -179,22 +212,39 @@ class DHT:
     async def get_alive_experts(self, prefix: str) -> dict[str, Endpoint]:
         return await self._bridge(self._get_alive(prefix))
 
-    async def _get_alive(self, prefix: str) -> dict[str, Endpoint]:
+    async def _get_alive(self, prefix: str) -> dict:
+        """uid → endpoint (single hoster) or tuple-of-endpoints (replica
+        set, sorted for determinism).  Subkey forms, newest first:
+
+        - ``"uid@host:port"`` — replica-aware prefix entry;
+        - ``"@host:port"`` / ``""`` — the queried key IS a full expert
+          uid (deepest prefix level of 1-D grids, where beam search
+          queries ``ffn.7`` directly);
+        - bare uid — legacy prefix entry from an old build.
+        """
         records = await self.node.get(prefix)
-        out = {}
-        for uid, (v, _) in records.items():
-            if uid == PLAIN_SUBKEY:
-                # the queried key IS a full expert uid (its own record) —
-                # happens for the deepest prefix level of 1-D grids, where
-                # beam search queries 'ffn.7' directly
-                endpoint = self._parse_endpoint(v)
-                if endpoint is not None:
-                    out[prefix] = endpoint
-                continue
+        eps: dict[str, list] = {}
+        for subkey, (v, _) in records.items():
             endpoint = self._parse_endpoint(v)
-            if endpoint is not None:  # skip malformed peer-supplied values
-                out[uid] = endpoint
-        return out
+            if endpoint is None:  # skip malformed peer-supplied values
+                continue
+            if subkey == PLAIN_SUBKEY:
+                uid = prefix
+            elif not isinstance(subkey, str):
+                continue
+            elif subkey.startswith("@"):
+                uid = prefix
+            elif "@" in subkey:
+                uid = subkey.rsplit("@", 1)[0]
+            else:
+                uid = subkey  # legacy bare-uid entry
+            bucket = eps.setdefault(uid, [])
+            if endpoint not in bucket:
+                bucket.append(endpoint)
+        return {
+            uid: (lst[0] if len(lst) == 1 else tuple(sorted(lst)))
+            for uid, lst in eps.items()
+        }
 
     async def first_k_active(
         self, prefixes: Sequence[str], k: int

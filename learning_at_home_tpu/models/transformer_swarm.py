@@ -66,6 +66,16 @@ class SwarmTransformerConfig:
     # RTT EMA (seconds) so routing avoids slow/overloaded peers
     # proactively (see client/moe.py latency_weight); 0 = off
     latency_weight: float = 0.0
+    # latency-aware routing cost model (ISSUE 8): bias selection by
+    # predicted completion time (RTT EMA + DHT-advertised queue depth +
+    # estimated transfer at the negotiated codec), minimized over each
+    # expert's replica set.  None falls back to latency_weight; 0 = off
+    # (bias=None, selection bitwise the blind gate).  See
+    # client/routing.py RoutingCostModel / DEFAULT_COST_WEIGHT.
+    routing_cost_weight: Any = None
+    # DHT scope of the ``load.<prefix>`` heartbeats the cost model reads
+    # (must match the servers' --telemetry-prefix; see utils/telemetry.py)
+    telemetry_prefix: str = "swarm"
 
 
 class SwarmDMoETransformerLM:
@@ -91,6 +101,8 @@ class SwarmDMoETransformerLM:
                 wire_dtype=config.wire_dtype,
                 wire_codec=config.wire_codec,
                 latency_weight=config.latency_weight,
+                routing_cost_weight=config.routing_cost_weight,
+                telemetry_prefix=config.telemetry_prefix,
             )
             for i in range(config.n_layers)
         ]

@@ -145,6 +145,9 @@ def main() -> None:
         ),
     )
     experts = server.experts
+    # replicas installed via the ``replica`` RPC restore from THIS
+    # server's checkpoint root (never a peer-supplied path)
+    server.replica_checkpoint_root = args.checkpoint_dir
     server.run_in_background()
     ckpt_step = 0
     if args.resume and args.checkpoint_dir:
@@ -153,9 +156,14 @@ def main() -> None:
             print(f"resumed from checkpoint step {ckpt_step}", flush=True)
         except FileNotFoundError:
             print("no checkpoint found; starting fresh", flush=True)
+    span = (
+        f"({sorted(experts)[0]}..{sorted(experts)[-1]}) " if experts
+        # a server may boot EMPTY and gain experts via replica RPCs
+        else "(none yet — replica-host mode) "
+    )
     print(
         f"serving {len(experts)} {args.expert_cls!r} experts "
-        f"({sorted(experts)[0]}..{sorted(experts)[-1]}) on "
+        f"{span}on "
         f"{server.endpoint[0]}:{server.endpoint[1]} "
         f"(metrics http://{server.endpoint[0]}:{server.metrics_port}/metrics)",
         flush=True,

@@ -461,6 +461,11 @@ class ConnectionHandler:
             "n_experts": len(srv.experts),
             "update_count_total": total_updates,
             "update_count": experts,
+            # replication observability (ISSUE 8): which hosted uids are
+            # replicas, and which experts are currently hot (queue-depth
+            # EMA over the threshold — the replicas.wanted signal)
+            "replicas": sorted(srv.replica_uids),
+            "hot_experts": srv.hot_experts(),
             "pools": pools,
             # hot-path pipeline counters: queue depth, stacking/materialize
             # time, overlap fraction, staging-buffer reuse (ISSUE 1)
@@ -563,6 +568,24 @@ class ConnectionHandler:
                     if backend is None:
                         raise ValueError(f"unknown expert uid: {uid!r}")
                     return reply("result", meta=backend.get_info())
+                elif msg_type == "replica":
+                    # rebalancer control plane (ISSUE 8): host a replica
+                    # of ``uid`` here.  The request carries ONLY the uid
+                    # (+ the sync flag) — checkpoint location is this
+                    # server's own configuration, never peer-supplied.
+                    if not isinstance(uid, str) or not uid:
+                        raise ValueError("replica request needs a uid")
+                    installed = await self.server.add_replica_async(
+                        uid, sync=bool(meta.get("sync"))
+                    )
+                    return reply(
+                        "result",
+                        meta={
+                            "uid": uid,
+                            "installed": bool(installed),
+                            "hosted": uid in self.server.experts,
+                        },
+                    )
                 elif msg_type == "stats":
                     return reply(
                         "result",

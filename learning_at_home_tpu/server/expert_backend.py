@@ -276,3 +276,15 @@ class ExpertBackend:
             self.params = jax.device_put(state["params"])
             self.opt_state = jax.device_put(state["opt_state"])
             self.update_count = int(state.get("update_count", 0))
+
+    def replace_params(self, params) -> None:
+        """Swap the parameter tree in place, keeping the optimizer state
+        (replica sync: an averaging round over the replicas of one
+        expert writes the group mean back here — server/server.py).  The
+        state lock serializes against a concurrent backward's donated
+        update, so the swap is never a torn read and the Runtime's next
+        job sees either tree, never a mix."""
+        with self._state_lock:
+            self.params = jax.device_put(
+                jax.tree_util.tree_map(np.asarray, params)
+            )

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import os
 import threading
 from typing import Any, Optional
 
@@ -68,6 +69,10 @@ class Server:
         self.dht = dht
         self.chaos = chaos.make() if hasattr(chaos, "make") else chaos
         self.update_period = update_period
+        self.batch_timeout = batch_timeout
+        # replica installs in flight (serving-loop state: single-threaded
+        # there, so a set is race-free without a lock)
+        self._replicas_installing: set[str] = set()
         self.runtime = Runtime()
         self.forward_pools: dict[str, TaskPool] = {}
         self.backward_pools: dict[str, TaskPool] = {}
@@ -109,6 +114,26 @@ class Server:
         self.metrics_server: Any = None
         self.metrics_port: Optional[int] = None
         self._metrics_loop: Optional[BackgroundLoop] = None
+        # dynamic expert replication (ISSUE 8): per-expert queue-depth
+        # EMAs sampled on the serving loop; experts whose EMA crosses the
+        # hot threshold are advertised under ``replicas.wanted.<prefix>``
+        # so the rebalancer (tools/lah_rebalance.py) can assign replicas
+        # to a less-loaded server.  ``_replica_recipe`` (set by
+        # Server.create) is how this server builds a replica backend on
+        # request; ``replica_checkpoint_root`` — and ONLY it, never a
+        # peer-supplied path — is where add_replica looks for a warmer
+        # start than the uid's deterministic crc32 init.
+        self._queue_ema: dict[str, float] = {}
+        try:
+            self.hot_depth_threshold = float(
+                os.environ.get("LAH_REPLICA_HOT_DEPTH", "8")
+            )
+        except ValueError:
+            self.hot_depth_threshold = 8.0
+        self._replica_recipe: Optional[dict] = None
+        self.replica_checkpoint_root: Optional[str] = None
+        self.replica_uids: set[str] = set()
+        self._replica_syncs: dict[str, "ReplicaSync"] = {}
         self._register_metrics_collector()
 
     def _register_metrics_collector(self) -> None:
@@ -162,7 +187,25 @@ class Server:
             "lah_server_batches_formed_total": batches,
             "lah_server_bucket_cold_compiles_total": cold,
             "lah_server_bucket_cache_hits_total": hits,
+            # replication observability (ISSUE 8): replicas this server
+            # hosts on behalf of other hosters, and experts currently
+            # over the hot queue-depth threshold
+            "lah_server_replica_experts_total": len(self.replica_uids),
+            "lah_server_hot_experts": sum(
+                1 for v in self._snap_queue_ema().values()
+                if v >= self.hot_depth_threshold
+            ),
         }
+
+    def _snap_queue_ema(self) -> dict:
+        # the serving loop replaces entries in place; scrape threads
+        # copy-with-retry like every other telemetry read
+        for _ in range(4):
+            try:
+                return dict(self._queue_ema)
+            except RuntimeError:
+                continue
+        return {}
 
     # ---- lifecycle ----
 
@@ -229,6 +272,22 @@ class Server:
                 "warmed %d programs in %.1fs", n, _time.monotonic() - t0
             )
         server = cls(experts, **server_kwargs)
+        # everything needed to build ANOTHER expert of this zoo on demand
+        # — the replica path (add_replica) constructs backends from this
+        server._replica_recipe = {
+            "expert_cls": expert_cls,
+            "hidden_dim": hidden_dim,
+            "optimizer": optimizer,
+            "max_batch_size": max_batch_size,
+            "n_inputs": n_wire_inputs,
+            # whether THIS server's experts were crc32-uid-seeded (the
+            # cross-process identical-init contract replicas rely on) —
+            # _make_replica_backend warns when a replica's crc32 init
+            # cannot be assumed to match the hoster's.  A server booted
+            # EMPTY (the rebalancer's replica-host pattern) carries no
+            # conflicting evidence and stays on the crc32 contract.
+            "uid_seeded": expert_uids is not None or not uid_keys,
+        }
         if start:
             server.run_in_background()
         return server
@@ -293,6 +352,9 @@ class Server:
             self.port = self._tcp_server.sockets[0].getsockname()[1]
         for pool in (*self.forward_pools.values(), *self.backward_pools.values()):
             pool.start(self.runtime)
+        asyncio.get_running_loop().create_task(
+            self._monitor_load_forever(), name="load-monitor"
+        )
         if self.dht is not None:
             asyncio.get_running_loop().create_task(
                 self._declare_experts_forever(), name="dht-heartbeat"
@@ -314,6 +376,10 @@ class Server:
             "experts": {
                 uid: b.update_count for uid, b in self.experts.items()
             },
+            # replication view (ISSUE 8): which hosted uids are replicas
+            # and which are currently hot — lah_top's REPLICAS column
+            "replicas": sorted(self.replica_uids),
+            "hot": self.hot_experts(),
             "runtime": self.runtime.stats(),
             "endpoint": list(self.endpoint),
         }
@@ -407,15 +473,51 @@ class Server:
                 for cid in [c for c, f in chains.items() if f.done()]:
                     del chains[cid]
 
+    async def _monitor_load_forever(self) -> None:
+        """Per-expert queue-depth EMA sampler (serving loop; qsize reads
+        only — never tensor work).  The EMAs feed three consumers: the
+        ``load.<prefix>`` heartbeat the client cost model reads, the
+        ``replicas.wanted.<prefix>`` hot-expert advertisements the
+        rebalancer acts on, and the server's own headline metrics."""
+        period = min(1.0, max(0.1, self.update_period / 4))
+        while True:
+            try:
+                for uid, pool in list(self.forward_pools.items()):
+                    depth = pool._tasks.qsize() + (
+                        1 if pool._carry is not None else 0
+                    )
+                    prev = self._queue_ema.get(uid, 0.0)
+                    self._queue_ema[uid] = 0.7 * prev + 0.3 * depth
+            except Exception:  # telemetry must never kill the loop task
+                logger.exception("load monitor sample failed")
+            await asyncio.sleep(period)
+
+    def hot_experts(self) -> dict[str, float]:
+        """uids whose queue-depth EMA crossed the hot threshold → EMA."""
+        return {
+            uid: round(ema, 3)
+            for uid, ema in self._snap_queue_ema().items()
+            if ema >= self.hot_depth_threshold
+        }
+
     async def _declare_experts_forever(self) -> None:
         """Liveness heartbeat: re-declare experts so DHT records stay
         fresh, and advertise the metrics endpoint under the
         ``telemetry.<prefix>`` key (utils/telemetry.py) with the same
         TTL — one missed heartbeat cycle and the swarm view marks this
-        peer dead."""
-        from learning_at_home_tpu.utils.telemetry import telemetry_key
+        peer dead.  The same cycle publishes the ``load.<prefix>`` record
+        (runtime queue depth + per-expert hot map, keyed by this RPC
+        endpoint so clients join it against expert records without an
+        extra lookup) and one ``replicas.wanted.<prefix>`` entry per
+        currently-hot expert."""
+        from learning_at_home_tpu.utils.telemetry import (
+            load_key,
+            replicas_wanted_key,
+            telemetry_key,
+        )
 
         peer_id = f"server-{self.endpoint[0]}:{self.port}"
+        ep_key = f"{self.endpoint[0]}:{self.port}"
         while True:
             try:
                 await self.dht.declare_experts(
@@ -427,6 +529,24 @@ class Server:
                         [self.endpoint[0], self.metrics_port, "server"],
                         expiration_delta=self.update_period * 2,
                         subkey=peer_id,
+                    )
+                hot = self.hot_experts()
+                await self.dht.store(
+                    load_key(self.telemetry_prefix),
+                    {
+                        "q": float(self.runtime.queue_depth),
+                        "n": len(self.experts),
+                        "hot": hot,
+                    },
+                    expiration_delta=self.update_period * 2,
+                    subkey=ep_key,
+                )
+                for uid, ema in hot.items():
+                    await self.dht.store(
+                        replicas_wanted_key(self.telemetry_prefix),
+                        [ema, self.endpoint[0], self.port],
+                        expiration_delta=self.update_period * 2,
+                        subkey=uid,
                     )
             except Exception:
                 logger.exception("declare_experts heartbeat failed")
@@ -465,6 +585,178 @@ class Server:
                     len(self.experts), root, step)
         return step
 
+    # ---- dynamic expert replication (ISSUE 8) ----
+
+    def _make_replica_backend(self, uid: str) -> ExpertBackend:
+        """Build a replica backend for ``uid``: the uid's deterministic
+        crc32-seeded init (every process that ever hosts a uid starts
+        from identical weights — Server.create's expert_uids contract),
+        upgraded to the latest state in this server's OWN checkpoint root
+        when one exists.  The root is local configuration, NEVER a
+        peer-supplied path — the replica RPC carries only the uid."""
+        import zlib
+
+        from learning_at_home_tpu.models import make_expert
+
+        recipe = self._replica_recipe
+        if recipe is None:
+            raise RuntimeError(
+                "server has no replica recipe: construct it via "
+                "Server.create (which records the expert zoo config), or "
+                "pass an explicit backend to add_replica"
+            )
+        apply_fn, params = make_expert(
+            recipe["expert_cls"], recipe["hidden_dim"],
+            jax.random.PRNGKey(zlib.crc32(uid.encode()) & 0x7FFFFFFF),
+        )
+        backend = ExpertBackend(
+            uid, apply_fn, params, recipe["optimizer"],
+            max_batch_size=recipe["max_batch_size"],
+            n_inputs=recipe["n_inputs"],
+        )
+        root = self.replica_checkpoint_root
+        restored = False
+        if root is not None:
+            from learning_at_home_tpu.utils.checkpoint import (
+                latest_step,
+                restore_pytree,
+            )
+
+            step = latest_step(root)
+            if step is not None:
+                try:
+                    state = restore_pytree(
+                        root, step, uid.replace("/", "_"),
+                        backend.state_template(),
+                    )
+                    backend.load_state_dict(state)
+                    restored = True
+                    logger.info(
+                        "replica %s restored from %s @ step %d",
+                        uid, root, step,
+                    )
+                except Exception:
+                    logger.exception(
+                        "replica %s: checkpoint restore failed — serving "
+                        "the crc32-seeded init (replica sync will pull it "
+                        "toward the group)", uid,
+                    )
+        if not restored and not recipe.get("uid_seeded"):
+            # the crc32 init matches hosters created with explicit
+            # expert_uids (crc32-uid seeding); a server whose OWN experts
+            # came from the num_experts/seed path is a strong hint the
+            # swarm seeds per-server — this replica's init then does NOT
+            # match the hoster's params, and only a checkpoint restore or
+            # ReplicaSync averaging aligns it.  Never silent.
+            logger.warning(
+                "replica %s: no checkpoint state to restore and this "
+                "server's experts are seed-path initialized (not "
+                "crc32-uid-seeded) — the replica starts from the uid's "
+                "crc32 init, which matches expert_uids-created hosters "
+                "only; enable replica sync (sync=true) or provide a "
+                "checkpoint root so replies stay numerically aligned",
+                uid,
+            )
+        return backend
+
+    async def _install_replica(self, uid: str, backend: ExpertBackend) -> None:
+        """Register + start pools for a replica ON the serving loop (the
+        connection handler reads ``self.experts`` there), then declare it
+        immediately so clients discover the new replica within one
+        alive-TTL instead of one heartbeat period."""
+        warm = lambda b=backend: getattr(b, "warm_buckets", ())
+        fp = TaskPool(
+            backend.forward, f"{uid}.forward",
+            max_batch_size=backend.max_batch_size,
+            batch_timeout=self.batch_timeout, serial_key=uid,
+            warm_buckets=warm,
+        )
+        bp = TaskPool(
+            lambda tensors, b=backend: b.backward(
+                tensors[: b.n_inputs], tensors[b.n_inputs :]
+            ),
+            f"{uid}.backward", max_batch_size=backend.max_batch_size,
+            batch_timeout=self.batch_timeout, serial_key=uid,
+            warm_buckets=warm,
+        )
+        self.experts[uid] = backend
+        self.forward_pools[uid] = fp
+        self.backward_pools[uid] = bp
+        self.replica_uids.add(uid)
+        fp.start(self.runtime)
+        bp.start(self.runtime)
+        if self.dht is not None:
+            try:
+                await self.dht.declare_experts(
+                    [uid], self.endpoint, expiration=self.update_period * 2
+                )
+            except Exception:
+                logger.exception(
+                    "replica %s: immediate declare failed (the heartbeat "
+                    "will retry)", uid,
+                )
+        logger.info("hosting replica of expert %s", uid)
+
+    async def add_replica_async(self, uid: str, sync: bool = False) -> bool:
+        """Loop-side replica install (the ``replica`` RPC's path).  The
+        backend build (param init / checkpoint restore — seconds of jax
+        work) runs in a worker thread so the serving loop never blocks.
+        Returns True when installed, False when already hosted or when
+        an install for the uid is in flight."""
+        if uid in self.experts or uid in self._replicas_installing:
+            return False
+        self._replicas_installing.add(uid)
+        try:
+            backend = await asyncio.to_thread(self._make_replica_backend, uid)
+            await self._install_replica(uid, backend)
+        finally:
+            self._replicas_installing.discard(uid)
+        if sync:
+            # ReplicaSync construction blocks on the lah-avg loop binding
+            # its peer endpoint (seconds) — never on the serving loop
+            await asyncio.to_thread(self.enable_replica_sync, uid)
+        return True
+
+    def add_replica(
+        self,
+        uid: str,
+        backend: Optional[ExpertBackend] = None,
+        sync: bool = False,
+        sync_period: float = 10.0,
+    ) -> bool:
+        """Host a replica of expert ``uid`` on this server (host-thread
+        form; the rebalancer's ``replica`` RPC reaches
+        :meth:`add_replica_async` instead).  ``sync=True`` also starts
+        periodic replica averaging (:class:`ReplicaSync`)."""
+        assert self._loop is not None, "server not started"
+        if uid in self.experts:
+            return False
+        if backend is None:
+            backend = self._make_replica_backend(uid)
+        self._loop.run(self._install_replica(uid, backend), timeout=30)
+        if sync:
+            self.enable_replica_sync(uid, period=sync_period)
+        return True
+
+    def enable_replica_sync(
+        self,
+        uid: str,
+        period: float = 10.0,
+        min_group_size: int = 2,
+    ) -> "ReplicaSync":
+        """Start periodic parameter averaging with the other hosters of
+        ``uid`` (idempotent per uid; requires a DHT for matchmaking)."""
+        if self.dht is None:
+            raise RuntimeError("replica sync needs a DHT for matchmaking")
+        existing = self._replica_syncs.get(uid)
+        if existing is not None:
+            return existing
+        sync = ReplicaSync(
+            self, uid, period=period, min_group_size=min_group_size
+        )
+        self._replica_syncs[uid] = sync
+        return sync
+
     @property
     def endpoint(self) -> tuple[str, int]:
         host = self.host
@@ -476,6 +768,9 @@ class Server:
         from learning_at_home_tpu.utils.metrics import registry
 
         registry.unregister_collector(self._collector_key)
+        for sync in list(self._replica_syncs.values()):
+            sync.stop()
+        self._replica_syncs.clear()
         if self._loop is None:
             return
         for pool in (*self.forward_pools.values(), *self.backward_pools.values()):
@@ -517,6 +812,90 @@ class Server:
                     self._pump.shutdown()
             self._pump = None
         logger.info("server shut down")
+
+
+class ReplicaSync:
+    """Keeps the replicas of ONE expert numerically aligned by running
+    periodic parameter-averaging rounds over the existing decentralized
+    averaging machinery (averaging/ — chunked butterfly all-reduce on the
+    same wire/codec stack): every server hosting ``uid`` with sync
+    enabled rendezvouses under ``averaging.replica.<uid>`` and writes the
+    group mean back via :meth:`ExpertBackend.replace_params`.  Optimizer
+    state stays local — it is per-hoster momentum, not shared identity.
+
+    Thread model (docs/CONCURRENCY.md): ONE daemon thread per synced
+    expert owns the blocking ``step_round`` calls; nothing here ever
+    runs on a server loop.  Matchmaking failures (a lone replica, a peer
+    mid-death) just skip the round — sync is convergence pressure for
+    independently-trained replicas, not a barrier."""
+
+    def __init__(
+        self,
+        server: "Server",
+        uid: str,
+        period: float = 10.0,
+        min_group_size: int = 2,
+        max_group_size: int = 16,
+    ):
+        from learning_at_home_tpu.averaging import (
+            AveragingConfig,
+            DecentralizedAverager,
+        )
+
+        self.server = server
+        self.uid = uid
+        self.period = period
+        self.rounds = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        cfg = AveragingConfig(
+            prefix=f"averaging.replica.{uid}",
+            min_group_size=min_group_size,
+            max_group_size=max_group_size,
+            matchmaking_timeout=max(2.0, period),
+            gather_timeout=min(4.0, max(1.0, period)),
+        )
+        self._averager = DecentralizedAverager(
+            server.dht, config=cfg,
+            peer_id=f"replica-{server.endpoint[0]}:{server.port}",
+        )
+        self._thread = threading.Thread(
+            target=self._run, name=f"lah-replica-sync-{uid}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            backend = self.server.experts.get(self.uid)
+            if backend is None:
+                break
+            try:
+                params = backend.state_dict()["params"]
+                averaged, _info = self._averager.step_round(
+                    params, matchmaking_timeout=self.period
+                )
+                if averaged is not None:
+                    backend.replace_params(averaged)
+                    self.rounds += 1
+            except Exception as e:
+                # lone replica / peer churn: skip this round, keep trying
+                self.failures += 1
+                logger.debug("replica sync round for %s skipped: %s: %s",
+                             self.uid, type(e).__name__, e)
+            self._stop.wait(self.period)
+
+    def stats(self) -> dict:
+        return {"uid": self.uid, "rounds": self.rounds,
+                "failures": self.failures}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            logger.warning("replica sync thread for %s did not join "
+                           "(mid-round); averager shutdown will cancel it",
+                           self.uid)
+        self._averager.shutdown()
 
 
 @contextlib.contextmanager

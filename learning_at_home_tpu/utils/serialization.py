@@ -87,6 +87,13 @@ BLOCKQ8_CLIP = 6.0  # quantization range in per-block standard deviations
 _CODEC_TO_DTYPE = {"bf16": "bfloat16", "f16": "float16"}
 _DTYPE_TO_CODEC = {v: k for k, v in _CODEC_TO_DTYPE.items()}
 
+# approximate wire-bytes multiplier vs raw f32 per codec — consumed by
+# the routing cost model's estimated-transfer term (client/routing.py);
+# the 8-bit codecs carry small per-block headers, hence 0.27 not 0.25
+CODEC_WIRE_RATIO = {
+    "none": 1.0, "bf16": 0.5, "f16": 0.5, "u8": 0.26, "blockq8": 0.27,
+}
+
 
 def is_float_dtype(dt) -> bool:
     """True for ANY floating dtype including ml_dtypes extension types.

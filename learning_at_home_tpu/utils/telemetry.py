@@ -8,9 +8,19 @@ IS the dead-peer detector**.  ``tools/lah_top.py`` then needs only a DHT
 bootstrap peer to find every live endpoint: no metrics endpoint is ever
 passed on a CLI.
 
-Key family (docs/PROTOCOL.md):
+Key families (docs/PROTOCOL.md):
 
-    telemetry.<prefix>   subkey=<peer_id> -> [host, port, role]
+    telemetry.<prefix>        subkey=<peer_id>    -> [host, port, role]
+    load.<prefix>             subkey="host:port"  -> {"q": queue depth,
+                              "n": experts, "hot": {uid: depth EMA}}
+    replicas.wanted.<prefix>  subkey=<uid>        -> [depth EMA, host, port]
+
+``load.*`` is the server-side load heartbeat the client routing cost
+model folds into expert selection (ISSUE 8): subkey is the RPC endpoint
+so clients join it against alive-expert records without another lookup.
+``replicas.wanted.*`` marks experts whose queue-depth EMA crossed the
+hot threshold — the rebalancer (tools/lah_rebalance.py) reads it to
+assign replicas to the least-loaded server.
 
 ``prefix`` scopes a swarm-wide view (default ``"swarm"``); running
 several logical swarms over one DHT just means distinct prefixes —
@@ -38,6 +48,57 @@ DEFAULT_PREFIX = "swarm"
 
 def telemetry_key(prefix: str = DEFAULT_PREFIX) -> str:
     return f"{TELEMETRY_KEY_FAMILY}.{prefix}"
+
+
+LOAD_KEY_FAMILY = "load"
+REPLICAS_WANTED_KEY_FAMILY = "replicas.wanted"
+
+
+def load_key(prefix: str = DEFAULT_PREFIX) -> str:
+    """Server load heartbeats: subkey = RPC ``host:port``, value a dict
+    (``parse_load_value``).  Consumed by the client RoutingCostModel."""
+    return f"{LOAD_KEY_FAMILY}.{prefix}"
+
+
+def replicas_wanted_key(prefix: str = DEFAULT_PREFIX) -> str:
+    """Hot-expert advertisements: subkey = expert uid, value
+    ``[queue-depth EMA, host, port]`` of the overloaded hoster."""
+    return f"{REPLICAS_WANTED_KEY_FAMILY}.{prefix}"
+
+
+def parse_load_value(value: Any) -> Optional[dict]:
+    """Peer-supplied load record → ``{"q": float, "n": int, "hot":
+    {uid: float}}``, or None when malformed.  ``hot`` is best-effort:
+    non-numeric entries are dropped, the record survives."""
+    if not isinstance(value, dict):
+        return None
+    try:
+        q = float(value.get("q", 0.0))
+        n = int(value.get("n", 0))
+    except (TypeError, ValueError):
+        return None
+    hot = {}
+    raw_hot = value.get("hot")
+    if isinstance(raw_hot, dict):
+        for uid, ema in raw_hot.items():
+            if isinstance(uid, str):
+                try:
+                    hot[uid] = float(ema)
+                except (TypeError, ValueError):
+                    continue
+    return {"q": q, "n": n, "hot": hot}
+
+
+def parse_wanted_value(value: Any) -> Optional[dict]:
+    """``[depth EMA, host, port]`` → {"depth", "endpoint"}, or None."""
+    try:
+        depth = float(value[0])
+        host, port = value[1], int(value[2])
+        if not isinstance(host, str):
+            return None
+        return {"depth": depth, "endpoint": (host, port)}
+    except (TypeError, ValueError, IndexError, KeyError):
+        return None
 
 
 def parse_telemetry_value(value: Any) -> Optional[dict]:

@@ -1,0 +1,290 @@
+"""Latency-aware routing cost model + replica-set plumbing (ISSUE 8).
+
+Pure/fast units: RoutingCostModel's predicted-completion-time scoring
+(and its bias=0 ⇒ bitwise-blind-gate A/B contract), alive-map replica
+normalization, the replica-aware DHT subkey scheme, the load/wanted
+telemetry record parsers, and the rebalancer's pure planning step.
+Hedged DISPATCH behavior (real servers) lives in test_replication.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from learning_at_home_tpu.client.routing import (
+    DEFAULT_COST_WEIGHT,
+    RoutingCostModel,
+    as_replica_set,
+    endpoint_key,
+    make_uid,
+    select_top_k,
+)
+from learning_at_home_tpu.utils.telemetry import (
+    load_key,
+    parse_load_value,
+    parse_wanted_value,
+    replicas_wanted_key,
+)
+
+EP_A = ("10.0.0.1", 9000)
+EP_B = ("10.0.0.2", 9000)
+EP_C = ("10.0.0.3", 9000)
+
+
+class FakePool:
+    def __init__(self, rtt_ema=None, bw_ema=None):
+        self.rtt_ema = rtt_ema
+        self.bw_ema = bw_ema
+
+
+class FakeRegistry:
+    """RoutingCostModel only needs ``peek`` (non-creating lookup)."""
+
+    def __init__(self, pools: dict):
+        self.pools = pools
+
+    def peek(self, endpoint):
+        return self.pools.get(endpoint)
+
+
+# ---- as_replica_set normalization ----
+
+
+def test_as_replica_set_bare_endpoint_is_singleton():
+    assert as_replica_set(("10.0.0.1", 9000)) == (("10.0.0.1", 9000),)
+    # list form and numeric-string port both normalize
+    assert as_replica_set(["10.0.0.1", "9000"]) == (("10.0.0.1", 9000),)
+
+
+def test_as_replica_set_preserves_order_and_dedupes():
+    got = as_replica_set((EP_B, EP_A, EP_B, ("10.0.0.1", "9000")))
+    assert got == (EP_B, EP_A)
+
+
+def test_as_replica_set_drops_malformed_entries():
+    # peer-supplied alive maps: junk inside a set is dropped, not raised
+    got = as_replica_set([EP_A, None, ("host",), (1234, 9000), "xx", EP_B])
+    assert got == (EP_A, EP_B)
+    assert as_replica_set([]) == ()
+
+
+# ---- the A/B contract: weight 0 ⇒ bias None ⇒ bitwise blind gate ----
+
+
+def test_zero_weight_bias_is_none_even_with_signal():
+    reg = FakeRegistry({EP_A: FakePool(rtt_ema=0.5)})
+    model = RoutingCostModel(0.0, registry=reg)
+    bias = model.bias(["u.0"], {"u.0": (EP_A,)})
+    assert bias is None
+    assert model.bias_applied == 0
+
+
+def test_select_top_k_bias_none_is_bitwise_identical():
+    """``bias=None`` must reproduce the no-bias call EXACTLY — the
+    acceptance criterion's bias=0 arm is today's selection bitwise."""
+    rs = np.random.RandomState(0)
+    logits = [rs.randn(16, 8).astype(np.float32)]
+    uids = [make_uid("p", (i,)) for i in range(8)]
+    sel0, coords0 = select_top_k(logits, uids, k=3)
+    sel1, coords1 = select_top_k(logits, uids, k=3, bias=None)
+    np.testing.assert_array_equal(sel0, sel1)
+    np.testing.assert_array_equal(coords0, coords1)
+
+
+def test_no_signal_anywhere_bias_is_none():
+    """Unmeasured swarm (no pool EMA, no load record): bias None — the
+    gate stays exactly blind rather than biased by a zeros vector."""
+    model = RoutingCostModel(DEFAULT_COST_WEIGHT, registry=FakeRegistry({}))
+    bias = model.bias(["u.0", "u.1"], {"u.0": (EP_A,), "u.1": (EP_B,)})
+    assert bias is None
+    assert model.bias_applied == 0
+
+
+# ---- predicted completion time ----
+
+
+def test_predicted_cost_sums_rtt_queue_and_transfer():
+    reg = FakeRegistry({EP_A: FakePool(rtt_ema=0.1, bw_ema=1e6)})
+    model = RoutingCostModel(
+        1.0, registry=reg, queue_cost_s=0.01, codec_ratio=0.5,
+        load_getter=lambda: {endpoint_key(EP_A): {"q": 4.0, "n": 2, "hot": {}}},
+    )
+    # rtt 0.1 + 4 queued × 0.01 + 1e6 B × 0.5 / 1e6 B/s = 0.1+0.04+0.5
+    cost = model.predicted_cost_s(EP_A, nbytes=1_000_000)
+    assert cost == pytest.approx(0.64, abs=1e-9)
+    # no bytes → no transfer term; unknown endpoint → None (no signal)
+    assert model.predicted_cost_s(EP_A) == pytest.approx(0.14, abs=1e-9)
+    assert model.predicted_cost_s(EP_B) is None
+
+
+def test_rtt_only_cost_reproduces_legacy_latency_weight_bias():
+    """With no load feed and no bandwidth measurement the model IS the
+    historical ``latency_weight`` bias: -weight × rtt_ema per uid."""
+    reg = FakeRegistry({EP_A: FakePool(rtt_ema=0.25), EP_B: FakePool()})
+    w = 20.0
+    model = RoutingCostModel(w, registry=reg)
+    uids = ["l.0", "l.1"]
+    bias = model.bias(uids, {"l.0": (EP_A,), "l.1": (EP_B,)})
+    legacy = np.zeros(2, np.float32)
+    legacy[0] = -w * 0.25  # exactly the pre-ISSUE-8 computation
+    np.testing.assert_array_equal(bias, legacy)
+    assert model.bias_applied == 1
+
+
+def test_bias_takes_min_cost_over_replica_set():
+    """A uid's cost is its CHEAPEST replica — the dispatch will pick it."""
+    reg = FakeRegistry(
+        {EP_A: FakePool(rtt_ema=0.5), EP_B: FakePool(rtt_ema=0.05)}
+    )
+    model = RoutingCostModel(10.0, registry=reg)
+    bias = model.bias(["r.0"], {"r.0": (EP_A, EP_B)})
+    assert bias[0] == pytest.approx(-10.0 * 0.05)
+
+
+def test_order_replicas_cheapest_first_deterministic():
+    reg = FakeRegistry(
+        {EP_A: FakePool(rtt_ema=0.5), EP_B: FakePool(rtt_ema=0.05)}
+    )
+    model = RoutingCostModel(0.0, registry=reg)  # ordering works at weight 0
+    assert model.order_replicas((EP_A, EP_B)) == (EP_B, EP_A)
+    # unmeasured replicas cost 0 (optimistic exploration) and exact ties
+    # break on the endpoint itself — stable across shuffles
+    assert model.order_replicas((EP_C, EP_B, EP_A)) == (EP_C, EP_B, EP_A)
+    assert model.order_replicas((EP_B, EP_C, EP_A)) == (EP_C, EP_B, EP_A)
+
+
+# ---- load feed: TTL refresh discipline ----
+
+
+def test_load_refresh_once_per_ttl_window_and_failure_keeps_stale():
+    calls = []
+
+    def getter():
+        calls.append(time.monotonic())
+        if len(calls) == 2:
+            raise OSError("dht flake")
+        return {endpoint_key(EP_A): {"q": float(len(calls)), "n": 1, "hot": {}}}
+
+    model = RoutingCostModel(
+        1.0, registry=FakeRegistry({}), load_getter=getter, load_ttl=0.05
+    )
+    assert model.queue_depth(EP_A) == 1.0
+    assert model.queue_depth(EP_A) == 1.0  # within TTL: no second call
+    assert len(calls) == 1
+    time.sleep(0.06)
+    # refresh fails → stale map survives one window, failure is counted
+    assert model.queue_depth(EP_A) == 1.0
+    assert len(calls) == 2
+    assert model.load_refresh_failures == 1
+    time.sleep(0.06)
+    assert model.queue_depth(EP_A) == 3.0
+    assert len(calls) == 3
+
+
+# ---- telemetry record parsers (peer-supplied → never raise) ----
+
+
+def test_parse_load_value():
+    rec = parse_load_value({"q": "3", "n": 2, "hot": {"u.0": 9.5, "u.1": "x"}})
+    assert rec == {"q": 3.0, "n": 2, "hot": {"u.0": 9.5}}
+    assert parse_load_value(["not", "a", "dict"]) is None
+    assert parse_load_value({"q": "NaN?", "n": {}}) is None
+    assert parse_load_value({})["q"] == 0.0
+
+
+def test_parse_wanted_value():
+    assert parse_wanted_value([9.5, "10.0.0.1", 9000]) == {
+        "depth": 9.5, "endpoint": ("10.0.0.1", 9000)
+    }
+    for junk in (None, [], ["x"], [1.0, 2, 3], [1.0, "h", "port"]):
+        assert parse_wanted_value(junk) is None
+
+
+def test_key_families_scoped_by_prefix():
+    assert load_key("swarm") == "load.swarm"
+    assert replicas_wanted_key("swarm") == "replicas.wanted.swarm"
+
+
+# ---- replica-aware DHT scheme ----
+
+
+def test_dht_replica_aware_declare_and_resolution():
+    """Two servers declaring ONE uid coexist as subkey records: readers
+    aggregate a replica set, single-endpoint resolution stays
+    deterministic, and a legacy bare-uid prefix record still reads as a
+    single-replica entry (mixed-build swarm)."""
+    from learning_at_home_tpu.dht import DHT
+
+    d1 = DHT()
+    d2 = DHT(initial_peers=[d1.endpoint])
+    reader = DHT(initial_peers=[d1.endpoint])
+    try:
+        d1.declare_experts_sync(["rep.0", "rep.1"], EP_A, expiration=30)
+        d2.declare_experts_sync(["rep.0"], EP_B, expiration=30)
+        alive = reader._loop.run(reader._get_alive("rep"))
+        # replicated uid → tuple (sorted); single hoster → bare endpoint
+        assert as_replica_set(alive["rep.0"]) == (EP_A, EP_B)
+        assert alive["rep.1"] == EP_A
+        # full-uid resolution picks ONE deterministic replica
+        eps = reader.get_experts_sync(["rep.0", "rep.1"])
+        assert eps["rep.0"] in (EP_A, EP_B)
+        assert eps["rep.1"] == EP_A
+        # legacy prefix entry (old build: subkey = bare uid) still counts
+        reader.store_sync("rep", [EP_C[0], EP_C[1]], 30, subkey="rep.2")
+        alive = reader._loop.run(reader._get_alive("rep"))
+        assert alive["rep.2"] == EP_C
+    finally:
+        reader.shutdown()
+        d2.shutdown()
+        d1.shutdown()
+
+
+# ---- rebalancer planning (tools/lah_rebalance.py, pure step) ----
+
+
+def _plan(wanted, loads, hosters, max_replicas=2):
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "lah_rebalance.py",
+    )
+    spec = importlib.util.spec_from_file_location("lah_rebalance", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.plan_actions(wanted, loads, hosters, max_replicas)
+
+
+def test_plan_actions_targets_least_loaded_hottest_first():
+    wanted = {
+        "h.0": {"depth": 12.0, "endpoint": EP_A},
+        "h.1": {"depth": 30.0, "endpoint": EP_A},
+    }
+    loads = {
+        endpoint_key(EP_A): {"q": 9.0, "n": 4},
+        endpoint_key(EP_B): {"q": 0.0, "n": 1},
+        endpoint_key(EP_C): {"q": 0.0, "n": 1},
+    }
+    hosters = {"h.0": {EP_A}, "h.1": {EP_A}}
+    actions = _plan(wanted, loads, hosters)
+    assert [a["uid"] for a in actions] == ["h.1", "h.0"]
+    # hottest goes to the least-loaded box (endpoint tie-break: B < C);
+    # the pass then spreads — B's planned count is bumped, so the next
+    # uid prefers the still-cold C instead of dog-piling B
+    assert actions[0]["target"] == EP_B
+    assert actions[1]["target"] == EP_C
+
+
+def test_plan_actions_respects_max_replicas_and_existing_hosters():
+    wanted = {"h.0": {"depth": 12.0, "endpoint": EP_A}}
+    loads = {
+        endpoint_key(EP_A): {"q": 0.0, "n": 1},
+        endpoint_key(EP_B): {"q": 0.0, "n": 1},
+    }
+    # already at 2 hosters → no action at max_replicas=2
+    assert _plan(wanted, loads, {"h.0": {EP_A, EP_B}}) == []
+    # the only candidate already hosts it → no action (never dog-pile)
+    assert _plan(wanted, {endpoint_key(EP_A): {"q": 0.0, "n": 1}},
+                 {"h.0": {EP_A}}) == []

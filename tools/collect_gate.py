@@ -15,7 +15,11 @@ one DHT-matched all-reduce round and must end with identical parameters
 (``averaging_stats()["rounds"] == 1``), then a TELEMETRY SMOKE (ISSUE
 4): one DHT-joined server must expose the always-on headline metrics on
 its Prometheus endpoint and be rendered by ``lah_top --once`` via DHT
-discovery alone.  Wire it before the full suite:
+discovery alone, then a REPLICATION SMOKE (ISSUE 8): an expert grown to
+two replicas via ``Server.add_replica`` + the replica-aware DHT scheme
+must survive a primary kill through the hedged dispatch fallback
+(hedge-win counter > 0, zero dropped samples).  Wire it before the full
+suite:
 
     python tools/collect_gate.py && pytest tests/ ...
 
@@ -151,7 +155,99 @@ def smoke_worker() -> int:
     rc = telemetry_smoke()
     if rc:
         return rc
+    rc = replication_smoke()
+    if rc:
+        return rc
     return overlap_smoke()
+
+
+def replication_smoke() -> int:
+    """Replication gate (ISSUE 8): one expert grown to TWO replicas —
+    the second installed through the real replica lifecycle
+    (``Server.add_replica`` on an initially-empty server) and advertised
+    via the replica-aware DHT subkey scheme — then the primary is
+    killed while the client's cached alive set still lists it (exactly
+    the stale window hedging exists for).  The next dispatch must
+    succeed through the hedged fallback with ZERO dropped samples, a
+    hedge-win counter > 0, and a bitwise-comparable reply (replicas
+    share the uid's crc32-seeded params)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+    from learning_at_home_tpu.client.routing import as_replica_set
+    from learning_at_home_tpu.client.rpc import pool_registry
+    from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.server.server import Server
+
+    hid = 16
+    boot = DHT()
+    d_a = DHT(initial_peers=[boot.endpoint])
+    d_b = DHT(initial_peers=[boot.endpoint])
+    d_c = DHT(initial_peers=[boot.endpoint])
+    srv_a = Server.create(
+        expert_uids=["rg.0"], hidden_dim=hid, host="127.0.0.1",
+        optimizer=optax.sgd(0.0), dht=d_a, update_period=1.0,
+    )
+    srv_b = Server.create(
+        num_experts=0, hidden_dim=hid, host="127.0.0.1",
+        optimizer=optax.sgd(0.0), dht=d_b, update_period=1.0,
+    )
+    try:
+        assert srv_b.add_replica("rg.0"), "replica install failed"
+        moe = RemoteMixtureOfExperts(
+            in_features=hid, grid_size=(1,), uid_prefix="rg", source=d_c,
+            k_best=1, k_min=1, forward_timeout=20.0, alive_ttl=60.0,
+            hedge_floor_s=0.05,
+        )
+        deadline = time.time() + 30
+        alive = {}
+        while time.time() < deadline:
+            alive = d_c._loop.run(d_c._get_alive("rg"))
+            if "rg.0" in alive and len(as_replica_set(alive["rg.0"])) == 2:
+                break
+            time.sleep(0.3)
+        assert len(as_replica_set(alive.get("rg.0", ()))) == 2, (
+            f"replica set never resolved: {alive}"
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(4, hid).astype(np.float32)
+        )
+        y0 = np.asarray(moe(x, gate))  # both alive; caches the alive set
+        # pin the dying server as PRIMARY, then kill it — the 60 s alive
+        # TTL keeps it in the cached set, so only hedging can save the
+        # next dispatch
+        pool_registry().get(srv_a.endpoint).rtt_ema = 0.001
+        pool_registry().get(srv_b.endpoint).rtt_ema = 0.5
+        srv_a.shutdown()
+        y1 = np.asarray(moe(x, gate))
+        np.testing.assert_allclose(y1, y0, atol=1e-5)
+        routing = moe.dispatch_stats()["routing"]
+        assert routing["hedge_wins"] >= 1, routing
+        assert moe.samples_dropped == 0, moe.samples_dropped
+        assert moe._headline_metrics()["lah_client_hedge_wins_total"] >= 1
+        print(
+            f"replication: replica_set=2 hedge_wins={routing['hedge_wins']}"
+            f" fires={routing['hedge_fires']} dropped=0"
+        )
+    finally:
+        for srv in (srv_a, srv_b):
+            try:
+                srv.shutdown()  # srv_a is already down (the kill) — fine
+            except Exception as e:
+                print(f"collect_gate: replica smoke teardown: {e!r}",
+                      file=sys.stderr)
+        reset_client_rpc()
+        for d in (d_a, d_b, d_c, boot):
+            d.shutdown()
+    print("REPLICA_SMOKE_OK hedge=first-reply-wins")
+    return 0
 
 
 def overlap_smoke() -> int:
@@ -441,9 +537,10 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # five smokes now (client path, averaging, codec, telemetry+
-            # lah_top subprocess, overlap): a wider bound than the gate's
-            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "600")),
+            # six smokes now (client path, averaging, codec, telemetry+
+            # lah_top subprocess, replication, overlap): a wider bound
+            # than the gate's
+            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "700")),
         )
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
@@ -454,6 +551,7 @@ def run_smoke() -> int:
         or "AVG_SMOKE_OK" not in r.stdout
         or "CODEC_SMOKE_OK" not in r.stdout
         or "TELEMETRY_SMOKE_OK" not in r.stdout
+        or "REPLICA_SMOKE_OK" not in r.stdout
         or "OVERLAP_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""lah_rebalance: assign replicas of HOT experts to the least-loaded
+servers (the small control-plane rebalancer of ISSUE 8 — MoETuner-style
+balanced expert placement, decentralized inputs).
+
+Inputs are DHT records only (no endpoint is ever typed on the CLI beyond
+the bootstrap peers):
+
+- ``replicas.wanted.<prefix>``  — experts whose hoster's queue-depth EMA
+  crossed the hot threshold (subkey=uid, value=[depth EMA, host, port]);
+- ``load.<prefix>``             — every server's load heartbeat
+  (subkey="host:port", value={"q": queue depth, "n": experts, "hot": …});
+- the expert's own full record  — its CURRENT replica set, so the tool
+  never over-replicates.
+
+For each hot expert (hottest first) with fewer than ``--max-replicas``
+hosters, the least-loaded server not already hosting it gets a
+``replica`` RPC.  The target restores the expert from ITS OWN checkpoint
+root (or the uid's deterministic crc32 init) and starts advertising —
+clients resolve the grown replica set on their next alive-TTL refresh
+and the hedged dispatch path takes it from there.
+
+Usage::
+
+    python tools/lah_rebalance.py --initial-peers 10.0.0.1:31338 --once
+    python tools/lah_rebalance.py --initial-peers ... --interval 10 --sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def parse_endpoint(s: str) -> tuple[str, int]:
+    host, sep, port = s.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(f"endpoint {s!r} must be host:port")
+    return (host, int(port))
+
+
+def current_hosters(dht, uid: str) -> set:
+    """The uid's live replica set from its full DHT record (subkeys
+    ``@host:port``; legacy ``""`` records count as one unnamed hoster)."""
+    from learning_at_home_tpu.dht import DHT
+
+    hosters = set()
+    for subkey, entry in dht.get_sync(uid).items():
+        value = entry[0] if isinstance(entry, (tuple, list)) else entry
+        endpoint = DHT._parse_endpoint(value)
+        if endpoint is not None:
+            hosters.add(endpoint)
+    return hosters
+
+
+def plan_actions(
+    wanted: dict, loads: dict, hosters: dict, max_replicas: int
+) -> list[dict]:
+    """Pure planning step (unit-testable): which (uid → target endpoint)
+    replica assignments to issue this pass.
+
+    ``wanted``: uid → {"depth", "endpoint"} (parse_wanted_value output);
+    ``loads``: "host:port" → {"q", "n", ...} (parse_load_value output);
+    ``hosters``: uid → set of endpoints currently hosting it.
+    Hottest experts first; each action targets the least-loaded server
+    (queue depth, then expert count, then endpoint for determinism) that
+    does not already host the uid.  A server picked for one uid has its
+    planned expert count bumped so one pass spreads replicas instead of
+    dog-piling the single coldest box."""
+    planned_n = {}
+    actions = []
+    for uid, rec in sorted(
+        wanted.items(), key=lambda kv: -kv[1].get("depth", 0.0)
+    ):
+        have = set(hosters.get(uid, ()))
+        if len(have) >= max_replicas:
+            continue
+        candidates = []
+        for ep_key, load in loads.items():
+            host, _, port = ep_key.rpartition(":")
+            if not port.isdigit():
+                continue
+            endpoint = (host, int(port))
+            if endpoint in have:
+                continue
+            n = load.get("n", 0) + planned_n.get(endpoint, 0)
+            candidates.append((load.get("q", 0.0), n, endpoint))
+        if not candidates:
+            continue
+        _q, _n, target = min(candidates)
+        planned_n[target] = planned_n.get(target, 0) + 1
+        actions.append(
+            {"uid": uid, "target": target, "depth": rec.get("depth", 0.0)}
+        )
+    return actions
+
+
+def run_pass(dht, prefix: str, max_replicas: int, sync: bool) -> list[dict]:
+    """One discover → plan → execute pass; returns executed actions
+    (each stamped with the replica RPC's outcome)."""
+    from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+    from learning_at_home_tpu.utils.telemetry import (
+        load_key,
+        parse_load_value,
+        parse_wanted_value,
+        replicas_wanted_key,
+    )
+
+    def parse_records(key, parse):
+        out = {}
+        for subkey, entry in dht.get_sync(key).items():
+            value = entry[0] if isinstance(entry, (tuple, list)) else entry
+            parsed = parse(value)
+            if isinstance(subkey, str) and parsed is not None:
+                out[subkey] = parsed
+        return out
+
+    wanted = parse_records(replicas_wanted_key(prefix), parse_wanted_value)
+    loads = parse_records(load_key(prefix), parse_load_value)
+    hosters = {uid: current_hosters(dht, uid) for uid in wanted}
+    actions = plan_actions(wanted, loads, hosters, max_replicas)
+    for action in actions:
+        pool = pool_registry().get(action["target"])
+        try:
+            _tensors, meta = client_loop().run(
+                pool.rpc(
+                    "replica", (),
+                    {"uid": action["uid"], "sync": sync},
+                    timeout=60.0,
+                )
+            )
+            action["installed"] = bool(meta.get("installed"))
+            action["hosted"] = bool(meta.get("hosted"))
+        except Exception as e:  # a dying target must not kill the pass
+            action["error"] = f"{type(e).__name__}: {e}"
+    return actions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--prefix", default="swarm",
+                    help="telemetry/load/replicas.wanted DHT scope")
+    ap.add_argument("--initial-peers", nargs="+", required=True,
+                    help="host:port DHT bootstrap peers")
+    ap.add_argument("--max-replicas", type=int, default=2,
+                    help="never grow an expert past this many hosters")
+    ap.add_argument("--sync", action="store_true",
+                    help="ask targets to start replica param averaging "
+                         "(ReplicaSync) for installed replicas")
+    ap.add_argument("--once", action="store_true",
+                    help="one pass, JSON actions on stdout, exit 0")
+    ap.add_argument("--interval", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.dht import DHT
+
+    dht = DHT(initial_peers=[parse_endpoint(s) for s in args.initial_peers])
+    try:
+        while True:
+            actions = run_pass(
+                dht, args.prefix, args.max_replicas, args.sync
+            )
+            print(json.dumps({"actions": actions}), flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        dht.shutdown()
+        reset_client_rpc()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,9 +120,14 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
         "",
         f"{'PEER':<28} {'ROLE':<8} {'HEALTH':<12} {'JOBS':>8} "
         f"{'QDEPTH':>6} {'OVERLAP':>8} {'PADWASTE':>9} {'DISP':>8} "
-        f"{'INFLT':>6} {'AVG(dg/ok)':>11}",
+        f"{'INFLT':>6} {'HEDGE(w/f)':>11} {'AVG(dg/ok)':>11}",
     ]
     experts: dict[str, float] = {}
+    # replication view (ISSUE 8): how many servers host each uid, which
+    # hosted copies are replicas, and which uids run hot anywhere
+    expert_hosts: dict[str, int] = {}
+    replica_uids: set[str] = set()
+    hot_uids: set[str] = set()
     for row in rows:
         m = _collected(row)
         jobs = _num(m.get("lah_server_jobs_processed_total"))
@@ -143,6 +148,10 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
             else _num(m.get("lah_client_overlap_fraction"))
         )
         inflight = int(_num(m.get("lah_client_inflight_dispatches")))
+        # hedged replica dispatch (ISSUE 8): wins/fires per trainer —
+        # how often a backup replica actually rescued a dispatch
+        hedge_w = int(_num(m.get("lah_client_hedge_wins_total")))
+        hedge_f = int(_num(m.get("lah_client_hedge_fires_total")))
         lines.append(
             f"{row['peer_id']:<28.28} {row['role']:<8.8} "
             f"{peer_health(row):<12} {int(jobs):>8} "
@@ -151,17 +160,34 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
             f"{(padded / denom if denom else 0.0):>9.3f} "
             f"{int(_num(m.get('lah_client_dispatches_total'))):>8} "
             f"{inflight:>6} "
+            f"{hedge_w:>5}/{hedge_f:<5} "
             f"{int(degraded):>5}/{int(rounds):<5}"
         )
         for uid, n in _section(row, "experts").items():
             experts[uid] = experts.get(uid, 0) + _num(n)
+            expert_hosts[uid] = expert_hosts.get(uid, 0) + 1
+        snap = row.get("snapshot") or {}
+        replicas = snap.get("replicas")
+        if isinstance(replicas, list):
+            replica_uids.update(u for u in replicas if isinstance(u, str))
+        hot_uids.update(u for u in _section(row, "hot"))
     for peer_id in sorted(dead):
         lines.append(f"{peer_id:<28.28} {'?':<8} {'DEAD':<12} (record expired)")
     if experts:
         lines.append("")
-        lines.append("EXPERTS (async update counts, merged across servers):")
+        lines.append(
+            "EXPERTS (async update counts merged across servers; REPLICAS "
+            "= hosting servers):"
+        )
+        lines.append(f"  {'UID':<32} {'UPDATES':>10} {'REPLICAS':>9}")
         for uid in sorted(experts):
-            lines.append(f"  {uid:<32} {int(experts[uid]):>10}")
+            flags = ("  HOT" if uid in hot_uids else "") + (
+                "  +replica" if uid in replica_uids else ""
+            )
+            lines.append(
+                f"  {uid:<32} {int(experts[uid]):>10} "
+                f"{expert_hosts.get(uid, 0):>9}{flags}"
+            )
     # span-level latency only exists on peers running LAH_PROFILE=1
     p99 = {}
     for row in rows:
