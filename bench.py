@@ -181,7 +181,7 @@ def run_dispatch_microbench(deadline: int = 600) -> dict | None:
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "4064fe4"
+PREV_ROUND_REV = "6b50fdb"
 
 
 def check_orphan_servers() -> dict | None:

@@ -71,6 +71,20 @@ def parse_args():
                         "(PipelinedSwarmTrainer; 1 = sequential). Overlaps "
                         "each step's RPC quorum waits with the next step's "
                         "trunk compute — delayed parameter updates.")
+    p.add_argument("--overlap", action="store_true",
+                   help="swarm mode: drive the ScMoE-style shortcut "
+                        "schedule (ISSUE 7's fire/join dispatch — each "
+                        "layer's expert fan-out flies while its attention "
+                        "computes).  Opt-in: the shortcut WIRING differs "
+                        "from the default apply, so loss curves are "
+                        "comparable only against --overlap-serial (same "
+                        "ops, serial schedule — the A/B parity arm)")
+    p.add_argument("--overlap-serial", action="store_true",
+                   help="swarm mode: the shortcut architecture with the "
+                        "SERIAL schedule (join right after fire) — "
+                        "bitwise the same math as --overlap, no "
+                        "communication/compute overlap; the baseline arm "
+                        "of the loss-parity smoke")
     p.add_argument("--chaos-bandwidth", type=float, default=0.0,
                    help="swarm mode: emulated server link bandwidth in "
                         "bytes/sec (0 = unlimited) — loopback hides "
@@ -172,6 +186,15 @@ def parse_args():
     if args.averaging and args.mode != "swarm":
         p.error("--averaging requires --mode swarm (pod mode's trunk is "
                 "one SPMD program — it cannot diverge)")
+    if args.overlap and args.overlap_serial:
+        p.error("--overlap and --overlap-serial are the two arms of one "
+                "A/B — pick one")
+    if (args.overlap or args.overlap_serial) and args.mode != "swarm":
+        p.error("--overlap[-serial] requires --mode swarm (pod mode has "
+                "no remote dispatch to overlap)")
+    if (args.overlap or args.overlap_serial) and args.pipeline > 1:
+        p.error("--overlap[-serial] drives the sequential step; "
+                "--pipeline overlap is a different axis (pick one)")
     return args
 
 
@@ -497,7 +520,19 @@ def run_swarm(args):
     params = model.init_params(jax.random.PRNGKey(args.seed))
     optimizer = optax.adamw(args.lr)
     opt_state = optimizer.init(params)
-    step_fn = model.make_train_step(optimizer)
+    if args.overlap or args.overlap_serial:
+        # ScMoE shortcut schedule (ISSUE 7/9): fire the expert fan-out,
+        # compute attention while the RPCs fly, join late.  The serial
+        # arm runs the SAME primitive ops joined immediately — loss
+        # curves between the two arms are the bitwise A/B contract the
+        # parity smoke asserts (tests/test_experiment_smoke.py)
+        step_fn = model.make_overlapped_train_step(
+            optimizer, overlap=args.overlap
+        )
+        print(f"# shortcut schedule: "
+              f"{'overlapped' if args.overlap else 'serial'}", flush=True)
+    else:
+        step_fn = model.make_train_step(optimizer)
 
     avg_session = None
     if args.averaging:
@@ -814,6 +849,10 @@ def run_multi_trainer(args):
         ]
         if args.data:
             base += ["--data", args.data]
+        if args.overlap:
+            base += ["--overlap"]
+        if args.overlap_serial:
+            base += ["--overlap-serial"]
         if args.averaging:
             base += [
                 "--averaging",

@@ -118,6 +118,7 @@ class RemoteMixtureOfExperts:
         telemetry_prefix: str = "swarm",
         hedge_mult: Optional[float] = None,
         hedge_floor_s: Optional[float] = None,
+        alive_swr: Optional[bool] = None,
     ):
         if routing not in ("enumerate", "beam"):
             raise ValueError(f"routing must be 'enumerate' or 'beam', got {routing!r}")
@@ -247,7 +248,13 @@ class RemoteMixtureOfExperts:
         # alive-set resolution (host-thread writes, copy-on-read scrapes)
         self._replica_counts: dict[str, int] = {}
         self.source = source
-        self.alive_cache = CachedAliveSet(source, uid_prefix, ttl=alive_ttl)
+        # alive_swr: serve a stale alive set while a background task
+        # refreshes it (CachedAliveSet; None → LAH_ALIVE_SWR env) — under
+        # churn the discovery lookup can stall behind dead DHT peers and
+        # must not block the dispatch path (ISSUE 9)
+        self.alive_cache = CachedAliveSet(
+            source, uid_prefix, ttl=alive_ttl, swr=alive_swr
+        )
         self._sessions: OrderedDict[int, dict] = OrderedDict()
         self._sessions_lock = sanitizer.lock("moe.sessions")
         self.max_sessions = max_sessions

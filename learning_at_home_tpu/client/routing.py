@@ -17,7 +17,7 @@ import asyncio
 import logging
 import os
 import time
-from typing import Callable, Iterable, Optional, Protocol, Sequence
+from typing import Any, Callable, Iterable, Optional, Protocol, Sequence
 
 import numpy as np
 
@@ -281,21 +281,80 @@ async def beam_search_alive(
 
 class CachedAliveSet:
     """TTL cache over get_alive_experts — one discovery per window, not per
-    batch (keeps routing off the dispatch hot path)."""
+    batch (keeps routing off the dispatch hot path).
 
-    def __init__(self, source: ExpertSource, prefix: str, ttl: float = 3.0):
+    ``swr`` (stale-while-revalidate, ISSUE 9; also ``LAH_ALIVE_SWR=1``):
+    when the window expires, :meth:`get` serves the STALE set immediately
+    and refreshes in a background loop task instead of blocking the
+    dispatch on the discovery lookup.  Under churn a DHT lookup can
+    stall for seconds behind dead-but-not-yet-evicted peers — with swr
+    that cost never lands on the dispatch path, and the one-window
+    staleness it trades for is exactly what the hedge/retry machinery
+    already covers.  Opt-in for now: tests and chaos scenarios that
+    reason about when a kill becomes visible assume the blocking
+    refresh; flipping the default is a follow-up (ROADMAP item 4)."""
+
+    def __init__(
+        self,
+        source: ExpertSource,
+        prefix: str,
+        ttl: float = 3.0,
+        swr: Optional[bool] = None,
+    ):
         self.source = source
         self.prefix = prefix
         self.ttl = ttl
+        if swr is None:
+            swr = os.environ.get("LAH_ALIVE_SWR", "0") not in ("0", "")
+        self.swr = bool(swr)
         self._cached: Optional[dict[str, Endpoint]] = None
         self._stamp = 0.0
+        self._refreshing: Optional[Any] = None  # in-flight background task
+        self.stale_serves = 0
+        self.refresh_failures = 0
 
     async def get(self, force_refresh: bool = False) -> dict[str, Endpoint]:
         now = time.monotonic()
-        if force_refresh or self._cached is None or now - self._stamp > self.ttl:
+        stale = self._cached is None or now - self._stamp > self.ttl
+        if not (force_refresh or stale):
+            return self._cached
+        if not self.swr or self._cached is None or force_refresh:
+            # blocking refresh: first discovery (nothing to serve stale),
+            # an explicit force, or swr disabled (the historical path).
+            # Cancel any in-flight background refresh first: it started
+            # EARLIER, so letting it complete after this authoritative
+            # read could overwrite a fresher set with a staler one
+            # (e.g. resurrecting a just-killed endpoint for a full TTL)
+            if self._refreshing is not None and not self._refreshing.done():
+                self._refreshing.cancel()
+            self._refreshing = None
             self._cached = await self.source.get_alive_experts(self.prefix)
-            self._stamp = now
+            self._stamp = time.monotonic()
+            return self._cached
+        # stale-while-revalidate: hand back the stale set NOW; at most
+        # one background refresh in flight (loop-confined state — this
+        # coroutine and the task both run on the owning loop)
+        if self._refreshing is None or self._refreshing.done():
+            self._refreshing = asyncio.get_running_loop().create_task(
+                self._refresh_bg(), name=f"alive-refresh-{self.prefix}"
+            )
+        self.stale_serves += 1
         return self._cached
+
+    async def _refresh_bg(self) -> None:
+        try:
+            alive = await self.source.get_alive_experts(self.prefix)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # a failed background refresh keeps the stale set: routing
+            # degrades gracefully, exactly like the load-feed reads
+            self.refresh_failures += 1
+            logger.debug("alive-set refresh for %s failed: %s: %s",
+                         self.prefix, type(e).__name__, e)
+            return
+        self._cached = alive
+        self._stamp = time.monotonic()
 
     def peek_fresh(self) -> Optional[dict[str, Endpoint]]:
         """The cached alive set if still within TTL, else None — a pure

@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import signal
 import threading
-import time
 
 
 def parse_endpoint(s: str) -> tuple[str, int]:
@@ -52,8 +51,27 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=float, default=0.0,
                    help="seconds between checkpoints (0 = only on shutdown)")
+    p.add_argument("--checkpoint-keep-last", type=int, default=3,
+                   help="complete checkpoint steps to retain (older ones "
+                        "and crashed half-saves are pruned)")
     p.add_argument("--resume", action="store_true",
                    help="load the latest checkpoint before serving")
+    p.add_argument("--drain-on-term", action="store_true",
+                   help="graceful lifecycle (ISSUE 9): the first SIGTERM "
+                        "DRAINS instead of exiting — stop heartbeating "
+                        "(DHT expiry steers dispatch away), finish "
+                        "in-flight batches, migrate every expert's params"
+                        "+optimizer state to a successor (checkpoint "
+                        "fallback), then exit.  A second SIGTERM forces "
+                        "immediate shutdown")
+    p.add_argument("--drain-grace", type=float, default=None,
+                   help="seconds to keep serving after the drain starts "
+                        "(default: the declared record TTL, 2 x "
+                        "--update-period, so published records expire)")
+    p.add_argument("--drain-successor", default=None,
+                   help="host:port to migrate experts to on drain "
+                        "(default: least-loaded peer from the load.* "
+                        "DHT heartbeats)")
     p.add_argument("--warmup", type=int, nargs="*", default=None,
                    help="pre-compile fwd/bwd for these batch-bucket sizes "
                         "before serving (e.g. --warmup 64 256 1024); "
@@ -145,17 +163,31 @@ def main() -> None:
         ),
     )
     experts = server.experts
-    # replicas installed via the ``replica`` RPC restore from THIS
-    # server's checkpoint root (never a peer-supplied path)
+    # replicas installed via the ``replica`` RPC and the drain fallback
+    # restore from THIS server's checkpoint root (never peer-supplied)
     server.replica_checkpoint_root = args.checkpoint_dir
     server.run_in_background()
-    ckpt_step = 0
-    if args.resume and args.checkpoint_dir:
+    ckpt_mgr = None
+    if args.checkpoint_dir:
+        from learning_at_home_tpu.utils.checkpoint import CheckpointManager
+
+        ckpt_mgr = CheckpointManager(
+            args.checkpoint_dir, keep_last=args.checkpoint_keep_last
+        )
+    if args.resume and ckpt_mgr is not None:
         try:
-            ckpt_step = server.load_checkpoint(args.checkpoint_dir)
-            print(f"resumed from checkpoint step {ckpt_step}", flush=True)
+            step = server.load_checkpoint(args.checkpoint_dir)
+            server.restarts = ckpt_mgr.record_restart()
+            print(f"resumed from checkpoint step {step} "
+                  f"(restart #{server.restarts})", flush=True)
         except FileNotFoundError:
             print("no checkpoint found; starting fresh", flush=True)
+    if ckpt_mgr is not None and args.checkpoint_every > 0:
+        ckpt_mgr.start_periodic(
+            lambda step: server.save_checkpoint(args.checkpoint_dir, step),
+            args.checkpoint_every,
+        )
+        server.checkpoint_manager = ckpt_mgr
     span = (
         f"({sorted(experts)[0]}..{sorted(experts)[-1]}) " if experts
         # a server may boot EMPTY and gain experts via replica RPCs
@@ -170,21 +202,45 @@ def main() -> None:
     )
 
     stop = threading.Event()
+    drain_req = threading.Event()
+
+    def on_term(*_):
+        # first SIGTERM with --drain-on-term: graceful drain (handled by
+        # the main loop — a signal handler must not block through the
+        # whole sequence); second SIGTERM, or no drain flag: exit now
+        if args.drain_on_term and not drain_req.is_set():
+            drain_req.set()
+        else:
+            stop.set()
+
     signal.signal(signal.SIGINT, lambda *_: stop.set())
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    last_ckpt = time.monotonic()
-    while not stop.wait(timeout=1.0):
-        if (
-            args.checkpoint_dir
-            and args.checkpoint_every > 0
-            and time.monotonic() - last_ckpt >= args.checkpoint_every
-        ):
-            ckpt_step += 1
-            server.save_checkpoint(args.checkpoint_dir, ckpt_step)
-            last_ckpt = time.monotonic()
-    if args.checkpoint_dir:
-        server.save_checkpoint(args.checkpoint_dir, ckpt_step + 1)
-        print("final checkpoint saved", flush=True)
+    signal.signal(signal.SIGTERM, on_term)
+    successor = (
+        parse_endpoint(args.drain_successor) if args.drain_successor else None
+    )
+    drained = False
+    while not stop.wait(timeout=0.5):
+        if drain_req.is_set() and not drained:
+            drained = True
+            print("SIGTERM: draining (migrate experts, then exit) ...",
+                  flush=True)
+            server.start_drain(successor=successor, grace=args.drain_grace)
+        if drained and server.wait_drained(timeout=0.0):
+            print(f"drain complete: {server.drain_summary}", flush=True)
+            break
+    if ckpt_mgr is not None and not drained:
+        # a drain already checkpointed whatever it could not hand off;
+        # the plain-shutdown path snapshots everything here instead.
+        # Stop the periodic thread FIRST: racing it on next_step() could
+        # mark a torn two-writer snapshot complete
+        ckpt_mgr.stop()
+        step = ckpt_mgr.save_now(
+            lambda s: server.save_checkpoint(args.checkpoint_dir, s)
+        )
+        if step is None:
+            print("final checkpoint FAILED (see log)", flush=True)
+        else:
+            print(f"final checkpoint saved @ step {step}", flush=True)
     server.shutdown()
     if dht is not None:
         dht.shutdown()

@@ -466,6 +466,10 @@ class ConnectionHandler:
             # EMA over the threshold — the replicas.wanted signal)
             "replicas": sorted(srv.replica_uids),
             "hot_experts": srv.hot_experts(),
+            # elastic lifecycle (ISSUE 9): drain state, uptime, restarts
+            # and migration counters — one poll tells an operator whether
+            # this peer is SERVING, mid-drain, or freshly rejoined
+            "lifecycle": srv.lifecycle_info(),
             "pools": pools,
             # hot-path pipeline counters: queue depth, stacking/materialize
             # time, overlap fraction, staging-buffer reuse (ISSUE 1)
@@ -584,6 +588,56 @@ class ConnectionHandler:
                             "uid": uid,
                             "installed": bool(installed),
                             "hosted": uid in self.server.experts,
+                        },
+                    )
+                elif msg_type == "handoff":
+                    # live expert migration (ISSUE 9): a draining peer
+                    # streams one expert's params+opt state here in
+                    # sequential parts; the receiver installs and
+                    # declares the uid only after a bitwise-verified
+                    # install.  Always the RAW wire — a quantized
+                    # payload cannot be bitwise by construction.
+                    if wire is not None:
+                        raise ValueError(
+                            "handoff must travel the raw wire (no wire "
+                            "meta): migration is bitwise or it failed"
+                        )
+                    return reply(
+                        "result",
+                        meta=await self.server.handoff.handle_part(
+                            meta, tensors
+                        ),
+                    )
+                elif msg_type == "drain":
+                    # graceful-drain trigger (ISSUE 9): flip the server
+                    # into the drain sequence on its lah-drain thread
+                    # and reply immediately — callers watch the stats
+                    # RPC's lifecycle section (or process exit)
+                    kwargs = {}
+                    successor = meta.get("successor")
+                    if successor is not None:
+                        if not (
+                            isinstance(successor, (list, tuple))
+                            and len(successor) == 2
+                            and isinstance(successor[0], str)
+                            and isinstance(successor[1], int)
+                        ):
+                            raise ValueError(
+                                "drain successor must be [host, port]"
+                            )
+                        kwargs["successor"] = (successor[0], successor[1])
+                    grace = meta.get("grace")
+                    if grace is not None:
+                        kwargs["grace"] = float(grace)
+                    if meta.get("handoff") is not None:
+                        kwargs["handoff"] = bool(meta.get("handoff"))
+                    started = self.server.start_drain(**kwargs)
+                    return reply(
+                        "result",
+                        meta={
+                            "draining": True,
+                            "started": bool(started),
+                            "state": self.server.lifecycle_state,
                         },
                     )
                 elif msg_type == "stats":

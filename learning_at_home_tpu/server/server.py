@@ -22,15 +22,19 @@ import contextlib
 import logging
 import os
 import threading
+import time
 from typing import Any, Optional
 
 import jax
 import optax
 
+from learning_at_home_tpu.server import lifecycle
 from learning_at_home_tpu.server.connection_handler import ConnectionHandler
 from learning_at_home_tpu.server.expert_backend import ExpertBackend
+from learning_at_home_tpu.server.lifecycle import HandoffReceiver
 from learning_at_home_tpu.server.runtime import Runtime
 from learning_at_home_tpu.server.task_pool import TaskPool
+from learning_at_home_tpu.utils import sanitizer
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
 
 logger = logging.getLogger(__name__)
@@ -134,6 +138,22 @@ class Server:
         self.replica_checkpoint_root: Optional[str] = None
         self.replica_uids: set[str] = set()
         self._replica_syncs: dict[str, "ReplicaSync"] = {}
+        # elastic lifecycle (ISSUE 9): SERVING -> DRAINING -> DRAINED.
+        # The flag is written by the lah-drain thread (under the
+        # lifecycle lock) and only READ by the serving loop's heartbeat
+        # task and the handoff handler — plain attribute reads, no lock
+        # on the loop (docs/CONCURRENCY.md invariant 10).
+        self.lifecycle_state: str = lifecycle.SERVING
+        self.started_at = time.monotonic()
+        self.restarts = 0  # set by the CLI from the checkpoint root
+        self.draining_since: Optional[float] = None
+        self.migrated_in: set[str] = set()  # uids received via handoff
+        self.handoff = HandoffReceiver(self)
+        self._lifecycle_lock = sanitizer.lock("server.lifecycle")
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drained = threading.Event()
+        self.drain_summary: Optional[dict] = None
+        self.checkpoint_manager: Any = None
         self._register_metrics_collector()
 
     def _register_metrics_collector(self) -> None:
@@ -195,6 +215,14 @@ class Server:
                 1 for v in self._snap_queue_ema().values()
                 if v >= self.hot_depth_threshold
             ),
+            # lifecycle observability (ISSUE 9): drain state, peer age,
+            # restart-from-checkpoint count, verified migrations in
+            "lah_server_draining": (
+                0.0 if self.lifecycle_state == lifecycle.SERVING else 1.0
+            ),
+            "lah_server_uptime_seconds": time.monotonic() - self.started_at,
+            "lah_server_restarts_total": self.restarts,
+            "lah_server_handoffs_received_total": self.handoff.received,
         }
 
     def _snap_queue_ema(self) -> dict:
@@ -382,7 +410,28 @@ class Server:
             "hot": self.hot_experts(),
             "runtime": self.runtime.stats(),
             "endpoint": list(self.endpoint),
+            # lifecycle view (ISSUE 9): lah_top's STATE/UPTIME/RST columns
+            "lifecycle": self.lifecycle_info(),
         }
+
+    def lifecycle_info(self) -> dict:
+        """Serializable lifecycle snapshot (stats RPC + telemetry extra):
+        state, uptime, restart-from-checkpoint count, drain progress and
+        inbound-migration counters."""
+        info = {
+            "state": self.lifecycle_state,
+            "uptime_s": round(time.monotonic() - self.started_at, 1),
+            "restarts": self.restarts,
+            "handoff": self.handoff.stats(),
+            "migrated_in": sorted(self.migrated_in),
+        }
+        if self.draining_since is not None:
+            info["draining_for_s"] = round(
+                time.monotonic() - self.draining_since, 1
+            )
+        if self.drain_summary is not None:
+            info["drain_summary"] = self.drain_summary
+        return info
 
     def _native_worker(self, handler: ConnectionHandler) -> None:
         """THE single dispatcher thread: shovels whole frames from the
@@ -520,53 +569,81 @@ class Server:
         ep_key = f"{self.endpoint[0]}:{self.port}"
         while True:
             try:
-                await self.dht.declare_experts(
-                    list(self.experts), self.endpoint, expiration=self.update_period * 2
-                )
+                serving = self.lifecycle_state == lifecycle.SERVING
+                if serving:
+                    # a DRAINING server stops re-declaring its experts
+                    # (and its load/wanted records): the records it
+                    # already published expire within one TTL and new
+                    # dispatch steers away — DHT expiry IS the drain
+                    # announcement (hedges cover the stale window)
+                    await self.dht.declare_experts(
+                        list(self.experts), self.endpoint,
+                        expiration=self.update_period * 2,
+                    )
                 if self.metrics_port is not None:
+                    # telemetry keeps heartbeating through the drain so
+                    # observers (lah_top) see DRAINING, not a dead peer
                     await self.dht.store(
                         telemetry_key(self.telemetry_prefix),
                         [self.endpoint[0], self.metrics_port, "server"],
                         expiration_delta=self.update_period * 2,
                         subkey=peer_id,
                     )
-                hot = self.hot_experts()
-                await self.dht.store(
-                    load_key(self.telemetry_prefix),
-                    {
-                        "q": float(self.runtime.queue_depth),
-                        "n": len(self.experts),
-                        "hot": hot,
-                    },
-                    expiration_delta=self.update_period * 2,
-                    subkey=ep_key,
-                )
-                for uid, ema in hot.items():
+                if serving:
+                    hot = self.hot_experts()
                     await self.dht.store(
-                        replicas_wanted_key(self.telemetry_prefix),
-                        [ema, self.endpoint[0], self.port],
+                        load_key(self.telemetry_prefix),
+                        {
+                            "q": float(self.runtime.queue_depth),
+                            "n": len(self.experts),
+                            "hot": hot,
+                        },
                         expiration_delta=self.update_period * 2,
-                        subkey=uid,
+                        subkey=ep_key,
                     )
+                    for uid, ema in hot.items():
+                        await self.dht.store(
+                            replicas_wanted_key(self.telemetry_prefix),
+                            [ema, self.endpoint[0], self.port],
+                            expiration_delta=self.update_period * 2,
+                            subkey=uid,
+                        )
             except Exception:
                 logger.exception("declare_experts heartbeat failed")
             await asyncio.sleep(self.update_period)
 
     # ---- checkpoint / resume (SURVEY.md §5.4) ----
 
-    def save_checkpoint(self, root: str, step: int = 0) -> None:
+    def save_checkpoint(self, root: str, step: Optional[int] = None) -> int:
         """Snapshot every expert's params+opt_state (safe during serving:
-        each snapshot serializes against that expert's async updates)."""
+        each snapshot serializes against that expert's async updates).
+        ``step=None`` picks the next unused step number; the completion
+        marker is written only after every expert saved, so a crash
+        mid-save can never masquerade as a usable checkpoint.  Returns
+        the step saved."""
         from learning_at_home_tpu.utils.checkpoint import (
             mark_step_complete,
+            next_step,
             save_pytree,
         )
 
-        for uid, backend in self.experts.items():
+        step = next_step(root) if step is None else step
+        experts = dict(self.experts)
+        if not experts:
+            # never mark an EMPTY step complete: restore_latest would
+            # prefer it over the last real snapshot (a drained or
+            # replica-host-mode server simply has nothing to save)
+            logger.warning(
+                "checkpoint skipped: no experts to save (root %s)", root
+            )
+            return step
+        for uid, backend in experts.items():
             save_pytree(root, step, uid.replace("/", "_"), backend.state_dict())
         mark_step_complete(root, step)
         logger.info("checkpointed %d experts to %s @ step %d",
-                    len(self.experts), root, step)
+                    len(experts), root, step)
+        return step
+
 
     def load_checkpoint(self, root: str, step: Optional[int] = None) -> int:
         """Restore every hosted expert found in the checkpoint; returns the
@@ -585,15 +662,133 @@ class Server:
                     len(self.experts), root, step)
         return step
 
+    # ---- elastic lifecycle: graceful drain + live migration (ISSUE 9) ----
+
+    def pools_idle(self) -> bool:
+        """True when no task pool holds queued/carried work and the
+        Runtime queue is empty — the quiesce predicate the drain polls.
+        Cross-thread reads of loop-owned state: qsize/attribute reads
+        only, tolerate-never-crash like every other telemetry read."""
+        try:
+            if self.runtime.queue_depth > 0:
+                return False
+            for pool_map in (self.forward_pools, self.backward_pools):
+                for pool in list(pool_map.values()):
+                    if pool._tasks.qsize() > 0 or pool._carry is not None:
+                        return False
+        except RuntimeError:  # dict mutated under us: call it busy
+            return False
+        return True
+
+    def _begin_drain(self) -> bool:
+        """Atomically flip SERVING -> DRAINING; True if already past it."""
+        with self._lifecycle_lock:
+            if self.lifecycle_state != lifecycle.SERVING:
+                return True
+            self.lifecycle_state = lifecycle.DRAINING
+            self.draining_since = time.monotonic()
+            return False
+
+    def _finish_drain(self) -> None:
+        with self._lifecycle_lock:
+            self.lifecycle_state = lifecycle.DRAINED
+        self._drained.set()
+
+    @sanitizer.runs_on("host", site="server.drain")
+    def drain(
+        self,
+        successor: Optional[tuple] = None,
+        *,
+        grace: Optional[float] = None,
+        quiesce_timeout: float = 30.0,
+        handoff: bool = True,
+        handoff_timeout: float = 60.0,
+    ) -> dict:
+        """Blocking graceful drain (host thread ONLY — the sequence
+        sleeps through the record-expiry grace window and blocks on
+        handoff RPCs; see lifecycle.run_drain for the steps).  Returns
+        the drain summary; raises if a drain already ran/is running."""
+        summary = lifecycle.run_drain(
+            self, successor=successor, grace=grace,
+            quiesce_timeout=quiesce_timeout, handoff=handoff,
+            handoff_timeout=handoff_timeout,
+        )
+        self.drain_summary = summary
+        return summary
+
+    def start_drain(self, **kwargs) -> bool:
+        """Fire-and-watch drain on the dedicated ``lah-drain`` daemon
+        thread (the ``drain`` RPC's path — the serving loop must reply
+        immediately, never block through the sequence).  Idempotent:
+        False when a drain is already underway."""
+        with self._lifecycle_lock:
+            if (
+                self.lifecycle_state != lifecycle.SERVING
+                or self._drain_thread is not None
+            ):
+                return False
+
+            def _run():
+                try:
+                    self.drain(**kwargs)
+                except Exception:
+                    logger.exception("background drain failed")
+                    self._drained.set()  # waiters must not hang on a bug
+
+            self._drain_thread = threading.Thread(
+                target=_run, name="lah-drain", daemon=True
+            )
+        self._drain_thread.start()
+        return True
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        return self._drained.wait(timeout)
+
+    async def _declare_now(self, uid: str) -> None:
+        """Immediate single-uid declare (serving loop): new/updated
+        hosters become discoverable within one alive-TTL instead of one
+        heartbeat period.  Failures defer to the heartbeat."""
+        if self.dht is None:
+            return
+        try:
+            await self.dht.declare_experts(
+                [uid], self.endpoint, expiration=self.update_period * 2
+            )
+        except Exception:
+            logger.exception(
+                "%s: immediate declare failed (the heartbeat will retry)",
+                uid,
+            )
+
+    def _retire_expert(self, uid: str) -> None:
+        """Drop a handed-off expert (drain thread): requests arriving
+        after this get an unknown-expert error reply, which the client's
+        retry/hedge machinery absorbs like any dead peer.  Pool shutdown
+        runs on the serving loop, like Server.shutdown's."""
+        self.experts.pop(uid, None)
+        self.replica_uids.discard(uid)
+        sync = self._replica_syncs.pop(uid, None)
+        if sync is not None:
+            sync.stop()
+        for pool_map in (self.forward_pools, self.backward_pools):
+            pool = pool_map.pop(uid, None)
+            if pool is not None and self._loop is not None:
+                with contextlib.suppress(Exception):
+                    self._loop.loop.call_soon_threadsafe(pool.shutdown)
+
     # ---- dynamic expert replication (ISSUE 8) ----
 
-    def _make_replica_backend(self, uid: str) -> ExpertBackend:
+    def _make_replica_backend(
+        self, uid: str, allow_checkpoint: bool = True
+    ) -> ExpertBackend:
         """Build a replica backend for ``uid``: the uid's deterministic
         crc32-seeded init (every process that ever hosts a uid starts
         from identical weights — Server.create's expert_uids contract),
         upgraded to the latest state in this server's OWN checkpoint root
         when one exists.  The root is local configuration, NEVER a
-        peer-supplied path — the replica RPC carries only the uid."""
+        peer-supplied path — the replica RPC carries only the uid.
+        ``allow_checkpoint=False`` skips the restore-and-warn path: the
+        handoff receiver overwrites the whole state from the wire."""
         import zlib
 
         from learning_at_home_tpu.models import make_expert
@@ -614,7 +809,7 @@ class Server:
             max_batch_size=recipe["max_batch_size"],
             n_inputs=recipe["n_inputs"],
         )
-        root = self.replica_checkpoint_root
+        root = self.replica_checkpoint_root if allow_checkpoint else None
         restored = False
         if root is not None:
             from learning_at_home_tpu.utils.checkpoint import (
@@ -641,7 +836,7 @@ class Server:
                         "the crc32-seeded init (replica sync will pull it "
                         "toward the group)", uid,
                     )
-        if not restored and not recipe.get("uid_seeded"):
+        if allow_checkpoint and not restored and not recipe.get("uid_seeded"):
             # the crc32 init matches hosters created with explicit
             # expert_uids (crc32-uid seeding); a server whose OWN experts
             # came from the num_experts/seed path is a strong hint the
@@ -659,11 +854,15 @@ class Server:
             )
         return backend
 
-    async def _install_replica(self, uid: str, backend: ExpertBackend) -> None:
-        """Register + start pools for a replica ON the serving loop (the
-        connection handler reads ``self.experts`` there), then declare it
-        immediately so clients discover the new replica within one
-        alive-TTL instead of one heartbeat period."""
+    async def _install_replica(
+        self, uid: str, backend: ExpertBackend, replica: bool = True
+    ) -> None:
+        """Register + start pools for a new expert ON the serving loop
+        (the connection handler reads ``self.experts`` there), then
+        declare it immediately so clients discover the new hoster within
+        one alive-TTL instead of one heartbeat period.  ``replica=False``
+        installs without the replica bookkeeping (the handoff path: a
+        migrated expert is a full expert, not a copy of one)."""
         warm = lambda b=backend: getattr(b, "warm_buckets", ())
         fp = TaskPool(
             backend.forward, f"{uid}.forward",
@@ -682,28 +881,26 @@ class Server:
         self.experts[uid] = backend
         self.forward_pools[uid] = fp
         self.backward_pools[uid] = bp
-        self.replica_uids.add(uid)
+        if replica:
+            self.replica_uids.add(uid)
         fp.start(self.runtime)
         bp.start(self.runtime)
-        if self.dht is not None:
-            try:
-                await self.dht.declare_experts(
-                    [uid], self.endpoint, expiration=self.update_period * 2
-                )
-            except Exception:
-                logger.exception(
-                    "replica %s: immediate declare failed (the heartbeat "
-                    "will retry)", uid,
-                )
-        logger.info("hosting replica of expert %s", uid)
+        await self._declare_now(uid)
+        logger.info("hosting %s expert %s",
+                    "replica of" if replica else "migrated", uid)
 
     async def add_replica_async(self, uid: str, sync: bool = False) -> bool:
         """Loop-side replica install (the ``replica`` RPC's path).  The
         backend build (param init / checkpoint restore — seconds of jax
         work) runs in a worker thread so the serving loop never blocks.
-        Returns True when installed, False when already hosted or when
-        an install for the uid is in flight."""
-        if uid in self.experts or uid in self._replicas_installing:
+        Returns True when installed, False when already hosted, when an
+        install for the uid is in flight, or when this server is
+        draining (a peer about to exit must not take on new experts)."""
+        if (
+            uid in self.experts
+            or uid in self._replicas_installing
+            or self.lifecycle_state != lifecycle.SERVING
+        ):
             return False
         self._replicas_installing.add(uid)
         try:
@@ -768,6 +965,10 @@ class Server:
         from learning_at_home_tpu.utils.metrics import registry
 
         registry.unregister_collector(self._collector_key)
+        if self.checkpoint_manager is not None:
+            with contextlib.suppress(Exception):
+                self.checkpoint_manager.stop()
+            self.checkpoint_manager = None
         for sync in list(self._replica_syncs.values()):
             sync.stop()
         self._replica_syncs.clear()

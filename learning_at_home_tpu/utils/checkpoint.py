@@ -15,14 +15,18 @@ pruned with ``keep_last``.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import shutil
+import threading
 from typing import Any, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
 
 _STEP_RE = re.compile(r"^step_(\d{9})$")
 
@@ -61,6 +65,15 @@ def latest_step(root: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def next_step(root: str) -> int:
+    """The next unused step number — strictly above every existing step
+    directory, complete or not (a crashed half-save must never be
+    overwritten in place: its directory may hold a partially-written
+    item a same-numbered retry would merge with)."""
+    steps = list_steps(root, only_complete=False)
+    return (steps[-1] + 1) if steps else 1
+
+
 def save_pytree(root: str, step: int, name: str, tree: Any) -> str:
     """Save one pytree under <root>/step_XXXXXXXXX/<name>."""
     path = os.path.join(_step_dir(root, step), name)
@@ -90,12 +103,118 @@ def restore_pytree(root: str, step: int, name: str, like: Any = None) -> Any:
 
 
 def prune_old_steps(root: str, keep_last: int) -> None:
+    """Delete old step directories, keeping the newest ``keep_last``
+    COMPLETE steps.  Incomplete (crashed mid-save) steps are always
+    swept — except the newest directory, which may be a save currently
+    in progress by another thread/process.  By construction the only
+    complete step can never be deleted: it is always among the newest
+    ``keep_last >= 1`` complete steps."""
     steps = list_steps(root, only_complete=False)
     complete = set(list_steps(root))
     keep = set(sorted(complete)[-keep_last:]) if keep_last > 0 else complete
+    if steps:
+        # the newest directory might be a concurrent save that has not
+        # written its completion marker YET — never sweep it as garbage
+        keep.add(steps[-1])
     for step in steps:
         if step not in keep:
             shutil.rmtree(_step_dir(root, step), ignore_errors=True)
+
+
+_RESTARTS_FILE = ".restarts"
+
+
+class CheckpointManager:
+    """Server-side checkpoint lifecycle (ISSUE 9): periodic crash-safe
+    snapshots, pruning, and a persisted restart counter.
+
+    The manager owns the step-number bookkeeping (monotonic across
+    process restarts via :func:`next_step`) and a daemon thread that
+    calls the supplied ``save_fn(step)`` every ``every_s`` seconds —
+    ``save_fn`` writes the step's items and its completion marker (e.g.
+    ``Server.save_checkpoint``); the manager prunes afterwards.  A crash
+    at ANY point leaves the newest *complete* step restorable:
+    ``restore`` / ``latest_step`` never see a step without its marker.
+
+    ``record_restart`` persists how many times a server booted from this
+    root (the lah_top ``RST`` column): the count survives the restarts
+    it counts.
+    """
+
+    def __init__(self, root: str, keep_last: int = 3):
+        self.root = root
+        self.keep_last = keep_last
+        self.saves = 0
+        self.save_failures = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---- step bookkeeping ----
+
+    def next_step(self) -> int:
+        return next_step(self.root)
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.root)
+
+    def save_now(self, save_fn) -> Optional[int]:
+        """One snapshot: pick the next step, run ``save_fn(step)``,
+        prune.  Returns the step saved, or None on failure (periodic
+        checkpointing must never kill its owner)."""
+        step = self.next_step()
+        try:
+            save_fn(step)
+        except Exception:
+            self.save_failures += 1
+            logger.exception(
+                "checkpoint save @ step %d failed (root %s)", step, self.root
+            )
+            return None
+        self.saves += 1
+        prune_old_steps(self.root, self.keep_last)
+        return step
+
+    # ---- periodic thread ----
+
+    def start_periodic(self, save_fn, every_s: float) -> "CheckpointManager":
+        if self._thread is not None or every_s <= 0:
+            return self
+
+        def loop():
+            while not self._stop.wait(every_s):
+                self.save_now(save_fn)
+
+        self._thread = threading.Thread(
+            target=loop, name="lah-checkpointer", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # ---- restart counter ----
+
+    def restart_count(self) -> int:
+        try:
+            with open(os.path.join(self.root, _RESTARTS_FILE)) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def record_restart(self) -> int:
+        """Increment + persist the restart counter; returns the new
+        count.  Called once per boot-from-checkpoint."""
+        count = self.restart_count() + 1
+        os.makedirs(self.root, exist_ok=True)
+        tmp = os.path.join(self.root, _RESTARTS_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(str(count))
+        os.replace(tmp, os.path.join(self.root, _RESTARTS_FILE))
+        return count
 
 
 class TrainCheckpointer:

@@ -14,9 +14,14 @@ from learning_at_home_tpu.models.transformer import (
 )
 from learning_at_home_tpu.parallel import batch_sharding, make_mesh
 from learning_at_home_tpu.utils.checkpoint import (
+    CheckpointManager,
     TrainCheckpointer,
     latest_step,
     list_steps,
+    mark_step_complete,
+    next_step,
+    prune_old_steps,
+    save_pytree,
 )
 
 
@@ -70,6 +75,101 @@ def test_train_checkpointer_prunes(tmp_path):
     for s in (1, 2, 3, 4):
         ckpt.save(s, tree, tree)
     assert list_steps(str(tmp_path / "c")) == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# crash safety (ISSUE 9 satellites): a kill mid-save must never corrupt
+# recovery, and pruning must never delete the only complete step
+# ---------------------------------------------------------------------------
+
+
+def _crash_mid_save(root: str, step: int, tree):
+    """Simulate a kill between item writes and the completion marker:
+    the step directory exists with saved items but NO marker."""
+    save_pytree(root, step, "params", tree)
+    # crash here: mark_step_complete(root, step) never runs
+
+
+def test_restore_latest_ignores_kill_mid_save(tmp_path):
+    """Kill mid-save → restore_latest returns the last COMPLETE step."""
+    root = str(tmp_path / "crash")
+    tree_v1 = {"a": jnp.arange(4.0)}
+    ckpt = TrainCheckpointer(root, keep_last=3)
+    ckpt.save(1, tree_v1, tree_v1)
+    # a newer save dies after writing items but before the marker
+    _crash_mid_save(root, 2, {"a": jnp.zeros(4)})
+    assert latest_step(root) == 1
+    assert list_steps(root, only_complete=False) == [1, 2]
+    restored = ckpt.restore_latest(tree_v1, tree_v1)
+    assert restored is not None
+    step, params, _ = restored
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(params["a"]), np.arange(4.0))
+    # the next save NEVER reuses the crashed step's directory (a retry
+    # merging into half-written items would be unverifiable)
+    assert next_step(root) == 3
+
+
+def test_prune_never_deletes_only_complete_step(tmp_path):
+    root = str(tmp_path / "prune")
+    tree = {"a": jnp.ones(2)}
+    save_pytree(root, 5, "item", tree)
+    mark_step_complete(root, 5)
+    # crashed half-saves around it, newer and older
+    _crash_mid_save(root, 3, tree)
+    _crash_mid_save(root, 7, tree)
+    prune_old_steps(root, keep_last=1)
+    # the only complete step survives any keep_last >= 1 ...
+    assert list_steps(root) == [5]
+    # ... the OLD crashed step is swept, and the NEWEST directory is
+    # kept (it may be another process's save still in progress)
+    assert list_steps(root, only_complete=False) == [5, 7]
+    prune_old_steps(root, keep_last=5)
+    assert list_steps(root) == [5]
+
+
+def test_checkpoint_manager_periodic_prune_and_restart_counter(tmp_path):
+    import time
+
+    root = str(tmp_path / "mgr")
+    tree = {"a": jnp.ones(2)}
+
+    def save_fn(step):
+        save_pytree(root, step, "item", tree)
+        mark_step_complete(root, step)
+
+    mgr = CheckpointManager(root, keep_last=2)
+    assert mgr.save_now(save_fn) == 1
+    assert mgr.save_now(save_fn) == 2
+    assert mgr.save_now(save_fn) == 3
+    assert list_steps(root) == [2, 3]  # pruned to keep_last
+    assert mgr.saves == 3
+
+    # a failing save_fn is counted, never raises out of the manager
+    def bad_save(step):
+        raise RuntimeError("disk full")
+
+    assert mgr.save_now(bad_save) is None
+    assert mgr.save_failures == 1
+
+    # periodic thread keeps stepping until stopped
+    mgr2 = CheckpointManager(root, keep_last=2)
+    mgr2.start_periodic(save_fn, every_s=0.05)
+    deadline = time.time() + 10
+    while time.time() < deadline and mgr2.saves < 2:
+        time.sleep(0.05)
+    mgr2.stop()
+    assert mgr2.saves >= 2
+    saved = mgr2.saves
+    time.sleep(0.2)
+    assert mgr2.saves == saved  # really stopped
+
+    # restart counter persists across manager instances (it counts the
+    # restarts it survives)
+    assert mgr.restart_count() == 0
+    assert mgr.record_restart() == 1
+    assert CheckpointManager(root).restart_count() == 1
+    assert CheckpointManager(root).record_restart() == 2
 
 
 def test_server_checkpoint_resume(tmp_path):

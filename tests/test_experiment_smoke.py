@@ -99,6 +99,34 @@ def test_train_lm_swarm_subprocess_smoke():
 
 
 @pytest.mark.slow
+def test_train_lm_overlap_loss_parity_smoke():
+    """ISSUE 9 satellite (the PR 7 leftover): ``--overlap`` drives the
+    ScMoE shortcut schedule in train_lm; its loss curve must match the
+    serial arm (``--overlap-serial`` — same primitive ops, join-early
+    scheduling) on identical seeds/servers/data.  The schedules are
+    bitwise-comparable in one process (tests/test_overlap.py); across
+    two fresh swarm runs the curves must still agree to float tolerance
+    (each run's servers start from the same crc32-seeded experts)."""
+    common = [
+        "experiments/train_lm.py", "--mode", "swarm",
+        "--steps", "4", "--experts-per-layer", "2", "--n-servers", "1",
+        "--n-layers", "1", "--batch-size", "2", "--d-model", "16",
+        "--seq-len", "8", "--log-every", "1", "--seed", "3",
+    ]
+    losses = {}
+    for arm in ("--overlap", "--overlap-serial"):
+        lines = run_script(common + [arm], timeout=420)
+        losses[arm] = [l["loss"] for l in lines if "loss" in l]
+    assert losses["--overlap"], "overlapped arm produced no loss curve"
+    assert len(losses["--overlap"]) == len(losses["--overlap-serial"])
+    import numpy as np
+
+    np.testing.assert_allclose(
+        losses["--overlap"], losses["--overlap-serial"], atol=1e-4,
+    )
+
+
+@pytest.mark.slow
 def test_generate_lm_smoke():
     outs = run_script(
         ["experiments/generate_lm.py", "--no-checkpoint",

@@ -18,8 +18,12 @@ its Prometheus endpoint and be rendered by ``lah_top --once`` via DHT
 discovery alone, then a REPLICATION SMOKE (ISSUE 8): an expert grown to
 two replicas via ``Server.add_replica`` + the replica-aware DHT scheme
 must survive a primary kill through the hedged dispatch fallback
-(hedge-win counter > 0, zero dropped samples).  Wire it before the full
-suite:
+(hedge-win counter > 0, zero dropped samples), then the LIFECYCLE +
+SLO smokes (ISSUE 9): draining one of two servers mid-dispatch must
+cost zero failed dispatches with the successor serving the migrated
+experts bitwise, and the churn harness's fast profile must hold its
+SLO floors (throughput, dispatch p99, zero quorum failures during
+graceful drains).  Wire it before the full suite:
 
     python tools/collect_gate.py && pytest tests/ ...
 
@@ -158,7 +162,157 @@ def smoke_worker() -> int:
     rc = replication_smoke()
     if rc:
         return rc
-    return overlap_smoke()
+    rc = overlap_smoke()
+    if rc:
+        return rc
+    rc = lifecycle_smoke()
+    if rc:
+        return rc
+    return slo_smoke()
+
+
+def lifecycle_smoke() -> int:
+    """Lifecycle gate (ISSUE 9): drain one of two servers while a client
+    keeps dispatching — ZERO failed dispatches and zero dropped samples,
+    the successor serves the migrated expert with BITWISE-equal params
+    and optimizer state, and the drained server ends DRAINED with its
+    experts retired."""
+    import time
+
+    import jax
+    import numpy as np
+    import optax
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+    from learning_at_home_tpu.dht import DHT
+    from learning_at_home_tpu.server.server import Server
+
+    hid = 16
+    boot = DHT()
+    d_a = DHT(initial_peers=[boot.endpoint])
+    d_b = DHT(initial_peers=[boot.endpoint])
+    d_c = DHT(initial_peers=[boot.endpoint])
+    srv_a = Server.create(
+        expert_uids=["lg.0", "lg.1"], hidden_dim=hid, host="127.0.0.1",
+        optimizer=optax.adam(1e-3), dht=d_a, update_period=0.4,
+    )
+    srv_b = Server.create(
+        expert_uids=["lg.2", "lg.3"], hidden_dim=hid, host="127.0.0.1",
+        optimizer=optax.adam(1e-3), dht=d_b, update_period=0.4,
+    )
+    try:
+        moe = RemoteMixtureOfExperts(
+            in_features=hid, grid_size=(4,), uid_prefix="lg", source=d_c,
+            k_best=3, k_min=1, timeout_after_k_min=0.5,
+            forward_timeout=20.0, alive_ttl=0.4,
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(d_c._loop.run(d_c._get_alive("lg"))) == 4:
+                break
+            time.sleep(0.2)
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        x = np.random.RandomState(0).randn(8, hid).astype(np.float32)
+        failures = 0
+        want = None
+        for it in range(24):
+            if it == 6:
+                want = {
+                    uid: b.state_dict() for uid, b in srv_a.experts.items()
+                }
+                assert srv_a.start_drain(
+                    successor=srv_b.endpoint, grace=0.5, quiesce_timeout=5.0
+                )
+            try:
+                y = np.asarray(moe(np.asarray(x), gate))
+                assert np.isfinite(y).all()
+            except Exception:
+                failures += 1
+        assert srv_a.wait_drained(timeout=30.0), "drain never completed"
+        assert failures == 0, f"{failures} dispatches failed mid-drain"
+        assert moe.samples_dropped == 0, moe.samples_dropped
+        assert not srv_a.experts, "drained server still hosts experts"
+        assert srv_a.lifecycle_state == "DRAINED"
+        # successor serves the migrated experts BITWISE (params AND
+        # optimizer state — the live-migration acceptance contract)
+        for uid, state in want.items():
+            got = srv_b.experts[uid].state_dict()
+            for a, b in zip(
+                jax.tree_util.tree_leaves(
+                    {"params": state["params"],
+                     "opt_state": state["opt_state"]}
+                ),
+                jax.tree_util.tree_leaves(
+                    {"params": got["params"], "opt_state": got["opt_state"]}
+                ),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert srv_b.handoff.received == 2
+        print(
+            f"lifecycle: drained=2 experts migrated bitwise, "
+            f"failed_dispatches=0 dropped=0"
+        )
+    finally:
+        for srv in (srv_a, srv_b):
+            try:
+                srv.shutdown()
+            except Exception as e:
+                print(f"collect_gate: lifecycle smoke teardown: {e!r}",
+                      file=sys.stderr)
+        reset_client_rpc()
+        for d in (d_a, d_b, d_c, boot):
+            d.shutdown()
+    print("LIFECYCLE_SMOKE_OK migration=bitwise")
+    return 0
+
+
+def slo_smoke() -> int:
+    """SLO gate (ISSUE 9): the churn harness's fast profile — subprocess
+    servers under a sustained mixed graceful/hard kill-and-rejoin
+    schedule — must hold its floors: throughput >= 0.8x the churn-free
+    baseline, the dispatch p99 ceiling, and zero quorum failures during
+    graceful drains.  The harness exits non-zero on any violation; the
+    JSON report is re-checked here so the gate fails loudly with the
+    verdict, not just an exit code."""
+    import json
+    import tempfile
+
+    report = os.path.join(tempfile.mkdtemp(prefix="slo_gate_"), "slo.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "experiments/churn_experiment.py",
+                "--profile", "fast", "--report", report,
+            ],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=int(os.environ.get("COLLECT_GATE_SLO_TIMEOUT_S", "420")),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: SLO harness timed out", file=sys.stderr)
+        return 2
+    try:
+        with open(report) as f:
+            summary = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        summary = None
+    if r.returncode != 0 or not summary or not summary["slo"]["pass"]:
+        print("collect_gate: FAIL — SLO harness:", file=sys.stderr)
+        print((summary or {}).get("slo"), file=sys.stderr)
+        print(r.stdout[-1500:], file=sys.stderr)
+        print(r.stderr[-1500:], file=sys.stderr)
+        return r.returncode or 1
+    print(
+        f"slo: throughput_ratio={summary['throughput_ratio']} "
+        f"p99={summary['dispatch_p99_churn_ms']}ms "
+        f"kills={summary['kills']} "
+        f"graceful_failures="
+        f"{summary['quorum_failures_during_graceful_drains']}"
+    )
+    print("SLO_SMOKE_OK profile=fast")
+    return 0
 
 
 def replication_smoke() -> int:
@@ -537,10 +691,10 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # six smokes now (client path, averaging, codec, telemetry+
-            # lah_top subprocess, replication, overlap): a wider bound
-            # than the gate's
-            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "700")),
+            # eight smokes now (client path, averaging, codec, telemetry+
+            # lah_top subprocess, replication, overlap, lifecycle, SLO
+            # churn harness): a wider bound than the gate's
+            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "1100")),
         )
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
@@ -553,6 +707,8 @@ def run_smoke() -> int:
         or "TELEMETRY_SMOKE_OK" not in r.stdout
         or "REPLICA_SMOKE_OK" not in r.stdout
         or "OVERLAP_SMOKE_OK" not in r.stdout
+        or "LIFECYCLE_SMOKE_OK" not in r.stdout
+        or "SLO_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
               file=sys.stderr)

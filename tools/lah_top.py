@@ -113,12 +113,26 @@ def peer_health(row: dict) -> str:
     return "ok"
 
 
+def peer_lifecycle(row: dict) -> tuple[str, str, str]:
+    """(STATE, UPTIME, RST) strings for a peer row (ISSUE 9): servers
+    report SERVING/DRAINING/DRAINED plus uptime and how many times they
+    restarted from a checkpoint; peers without a lifecycle section
+    (trainers, old builds) render dashes."""
+    lc = _section(row, "lifecycle")
+    state = lc.get("state")
+    if not isinstance(state, str) or not state:
+        return "-", "-", "-"
+    uptime = int(_num(lc.get("uptime_s")))
+    return state, f"{uptime}s", str(int(_num(lc.get("restarts"))))
+
+
 def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
     lines = [
         f"lah_top — telemetry.{prefix} — {len(rows)} live peer(s), "
         f"{len(dead)} dead — {time.strftime('%H:%M:%S')}",
         "",
-        f"{'PEER':<28} {'ROLE':<8} {'HEALTH':<12} {'JOBS':>8} "
+        f"{'PEER':<28} {'ROLE':<8} {'STATE':<9} {'UPTIME':>7} {'RST':>3} "
+        f"{'HEALTH':<12} {'JOBS':>8} "
         f"{'QDEPTH':>6} {'OVERLAP':>8} {'PADWASTE':>9} {'DISP':>8} "
         f"{'INFLT':>6} {'HEDGE(w/f)':>11} {'AVG(dg/ok)':>11}",
     ]
@@ -152,8 +166,10 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
         # how often a backup replica actually rescued a dispatch
         hedge_w = int(_num(m.get("lah_client_hedge_wins_total")))
         hedge_f = int(_num(m.get("lah_client_hedge_fires_total")))
+        state, uptime, rst = peer_lifecycle(row)
         lines.append(
             f"{row['peer_id']:<28.28} {row['role']:<8.8} "
+            f"{state:<9.9} {uptime:>7} {rst:>3} "
             f"{peer_health(row):<12} {int(jobs):>8} "
             f"{int(_num(m.get('lah_server_queue_depth'))):>6} "
             f"{ovl:>8.2f} "
@@ -172,7 +188,10 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
             replica_uids.update(u for u in replicas if isinstance(u, str))
         hot_uids.update(u for u in _section(row, "hot"))
     for peer_id in sorted(dead):
-        lines.append(f"{peer_id:<28.28} {'?':<8} {'DEAD':<12} (record expired)")
+        lines.append(
+            f"{peer_id:<28.28} {'?':<8} {'DEAD':<9} {'-':>7} {'-':>3} "
+            f"(record expired)"
+        )
     if experts:
         lines.append("")
         lines.append(
