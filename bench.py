@@ -177,11 +177,63 @@ def run_dispatch_microbench(deadline: int = 600) -> dict | None:
     return None
 
 
+def run_dht_sim_bench(deadline: int = 420, sizes: str = "128,512") -> dict | None:
+    """DHT control-plane swarm series (ISSUE 11) in a scrubbed CPU
+    subprocess: per-node join time, lookup hit-rate under kill-and-replace
+    churn, and the coalesced-vs-per-key heartbeat store-RPC reduction,
+    with the floors asserted by the harness itself (``--check``)."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "experiments", "dht_swarm_sim.py"),
+             "--sizes", sizes, "--check"],
+            capture_output=True, text=True, timeout=deadline, cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: dht swarm sim timed out", file=sys.stderr)
+        return None
+    if r.returncode != 0 or "DHT_SWARM_SIM_OK" not in r.stdout:
+        print(f"bench: dht swarm sim rc={r.returncode}\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+        return None
+    per_size, scaling = [], None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "nodes" in d:
+            per_size.append(d)
+        elif "join_scaling" in d:
+            scaling = d["join_scaling"]
+    if not per_size:
+        return None
+    out = {
+        "dht_sim_nodes": [d["nodes"] for d in per_size],
+        "dht_sim_join_mean_ms": [d["join"]["mean_ms"] for d in per_size],
+        "dht_sim_hit_rate_min": min(d["churn"]["hit_rate"] for d in per_size),
+        "dht_sim_store_reduction_min": min(
+            d["heartbeat"]["reduction"] for d in per_size
+        ),
+    }
+    if scaling is not None:
+        out["dht_sim_join_sublinear"] = bool(scaling.get("sublinear"))
+    return out
+
+
 # The previous round's final commit: the CPU-fallback artifact compares
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "6b50fdb"
+PREV_ROUND_REV = "ad58216"
 
 
 def check_orphan_servers() -> dict | None:
@@ -363,6 +415,12 @@ def main() -> int:
         skw = run_skewed_routing_bench()
         if skw:
             result.update(skw)
+        # DHT control-plane series (ISSUE 11): host-side like dispatch;
+        # the two-size series keeps the full-bench wall bounded — the
+        # 1k-node run lives behind the standalone --dht-sim mode
+        dht = run_dht_sim_bench()
+        if dht:
+            result.update(dht)
     if box_dirty:
         result.update(box_dirty)
     print(json.dumps(result), flush=True)
@@ -1603,6 +1661,14 @@ if __name__ == "__main__":
     if "--skewed-worker" in sys.argv:
         skewed_routing_worker()
         sys.exit(0)
+    if "--dht-sim" in sys.argv:
+        # standalone DHT control-plane series (ISSUE 11): the full
+        # 128/512/1024 simulated-swarm run with the hit-rate,
+        # store-reduction, and sublinear-join floors asserted
+        _dht = run_dht_sim_bench(deadline=900, sizes="128,512,1024")
+        print(json.dumps(_dht if _dht else {"error": "dht sim failed"}),
+              flush=True)
+        sys.exit(0 if _dht else 1)
     if "--skewed-routing" in sys.argv:
         # standalone latency-aware-routing A/B (ISSUE 8): just the
         # zipf-skewed cost-model-vs-blind series, in the same scrubbed

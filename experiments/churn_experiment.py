@@ -62,14 +62,14 @@ PROFILES = {
         "experts_per_server": 2, "graceful_frac": 0.5, "ttl": 1.0,
         "max_down": 2, "step_interval": 0.75,
         "checkpoint_every": 3.0, "slo_p99_ms": 2500.0,
-        "timeout_after_k_min": 0.1, "dht_rpc_timeout": 0.35,
+        "timeout_after_k_min": 0.1,
     },
     "sustained": {
         "steps": 150, "kill_every": 10, "dead_for": 8, "n_servers": 3,
         "experts_per_server": 2, "graceful_frac": 0.5, "ttl": 2.0,
         "max_down": 2, "step_interval": 0.25,
         "checkpoint_every": 5.0, "slo_p99_ms": 2000.0,
-        "timeout_after_k_min": 0.25, "dht_rpc_timeout": 0.5,
+        "timeout_after_k_min": 0.25,
     },
 }
 
@@ -79,7 +79,7 @@ PROFILES = {
 FALLBACKS = {
     "steps": 40, "kill_every": 10, "dead_for": 8, "n_servers": 3,
     "experts_per_server": 2, "ttl": 2.0, "timeout_after_k_min": 0.25,
-    "dht_rpc_timeout": 1.0, "max_down": 1, "graceful_frac": 0.0,
+    "max_down": 1, "graceful_frac": 0.0,
     "step_interval": 0.0, "checkpoint_every": 0.0, "slo_p99_ms": 0.0,
 }
 
@@ -102,13 +102,10 @@ def parse_args():
     p.add_argument("--timeout-after-k-min", type=float, default=None,
                    help="client straggler grace once k_min replies landed "
                         "(default 0.25)")
-    p.add_argument("--dht-rpc-timeout", type=float, default=None,
-                   help="client-side Kademlia RPC timeout (s).  The stock "
-                        "3 s budget means every dead-but-not-yet-evicted "
-                        "DHT node can stall an alive-set refresh — ON the "
-                        "dispatch path — for seconds per lookup wave; "
-                        "under churn that, not expert latency, becomes "
-                        "the throughput ceiling")
+    # --dht-rpc-timeout retired (ISSUE 11): the DHT's per-peer adaptive
+    # timeout (floor/ceiling-clamped on each peer's RTT EMA) bounds what
+    # a dead-but-not-yet-evicted node can stall a lookup wave, so the
+    # fast/sustained profiles no longer need a tuned escape hatch.
     p.add_argument("--max-down", type=int, default=None,
                    help="max servers simultaneously dead-or-booting; kills "
                         "beyond this wait (an operator preserves capacity)")
@@ -270,10 +267,7 @@ def main():
         # must never orphan spawned server processes
         for i in range(args.n_servers):
             servers[i] = launch_server(i)
-        client_dht = DHT(
-            initial_peers=[bootstrap.endpoint],
-            rpc_timeout=args.dht_rpc_timeout,
-        )
+        client_dht = DHT(initial_peers=[bootstrap.endpoint])
 
         def get_alive() -> set:
             return set(client_dht._loop.run(client_dht._get_alive("churn")))

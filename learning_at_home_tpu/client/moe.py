@@ -244,6 +244,11 @@ class RemoteMixtureOfExperts:
         self.hedge_fires = 0
         self.hedge_wins = 0
         self.hedges_skipped = 0
+        # sole-endpoint rescue (ISSUE 11): non-replicated uids whose only
+        # endpoint hard-failed mid-record-TTL, re-resolved via a
+        # cache-bypassing alive lookup (same loop-thread ownership)
+        self.fresh_retries = 0
+        self.fresh_retry_wins = 0
         # replica observability: uid → replica count from the latest
         # alive-set resolution (host-thread writes, copy-on-read scrapes)
         self._replica_counts: dict[str, int] = {}
@@ -1073,6 +1078,8 @@ class RemoteMixtureOfExperts:
             "lah_client_hedge_fires_total": self.hedge_fires,
             "lah_client_hedge_wins_total": self.hedge_wins,
             "lah_client_hedges_skipped_total": self.hedges_skipped,
+            "lah_client_fresh_retries_total": self.fresh_retries,
+            "lah_client_fresh_retry_wins_total": self.fresh_retry_wins,
             "lah_client_replicated_experts": replicated,
             "lah_client_replicas_max": max(
                 replica_counts.values(), default=0
@@ -1149,6 +1156,10 @@ class RemoteMixtureOfExperts:
                 "hedge_wins": int(m["lah_client_hedge_wins_total"]),
                 "hedges_skipped": int(
                     m["lah_client_hedges_skipped_total"]
+                ),
+                "fresh_retries": int(m["lah_client_fresh_retries_total"]),
+                "fresh_retry_wins": int(
+                    m["lah_client_fresh_retry_wins_total"]
                 ),
                 "replicated_experts": int(
                     m["lah_client_replicated_experts"]
@@ -1835,11 +1846,47 @@ class RemoteMixtureOfExperts:
                 _cancel_with(t2, e)
                 raise
 
+        async def _rescue_single(failed_ep, uid) -> tuple[dict, tuple]:
+            """Sole-endpoint rescue (ISSUE 11): a NON-replicated uid has
+            no hedge backup, so when its only endpoint hard-fails inside
+            the record-TTL window the sample would lose the expert
+            outright.  One cache-bypassing refresh — record cache AND
+            alive-set cache both skipped (``get_alive_experts_fresh``) —
+            re-resolves the uid (a restarted/migrated host re-declares
+            within a heartbeat), and the SAME prepared payload retries
+            once at the fresh endpoint."""
+            self.fresh_retries += 1
+            alive = await self.alive_cache.get(force_refresh=True)
+            entry = alive.get(uid)
+            fresh_ep = None
+            if entry is not None:
+                fresh_ep = next(
+                    (
+                        ep for ep in as_replica_set(entry)
+                        if tuple(ep) != tuple(failed_ep)
+                    ),
+                    None,
+                )
+            if fresh_ep is None:
+                raise RemoteCallError(
+                    f"{uid}: sole endpoint {failed_ep} failed and the "
+                    f"fresh lookup found no replacement"
+                )
+            if not await _hedge_wire_ok(fresh_ep, [uid]):
+                raise RemoteCallError(
+                    f"{uid}: fresh endpoint {fresh_ep} cannot accept "
+                    f"the prepared wire form"
+                )
+            replies = await call_single(fresh_ep, uid)
+            self.fresh_retry_wins += 1
+            return replies, fresh_ep
+
         pending = {
             asyncio.ensure_future(run_group(ep, uids)): (ep, uids)
             for ep, uids in group_list
         }
         retried: set = set()  # endpoints whose merged call was disaggregated
+        rescued: set = set()  # uids given their one sole-endpoint rescue
         rows_of = {uid: job[2] for uid, job in jobs.items()}
         per_sample = np.zeros(batch, np.int64)
         results = {uid: (*job, None) for uid, job in jobs.items()}
@@ -1887,6 +1934,23 @@ class RemoteMixtureOfExperts:
                                     run_group(endpoint, [uid])
                                 )
                             ] = (endpoint, [uid])
+                    elif (
+                        msg_type == "forward"
+                        and len(uids) == 1
+                        and backups is not None
+                        and backups.get(uids[0]) is None
+                        and uids[0] not in rescued
+                    ):
+                        # non-replicated uid, sole endpoint dead: one
+                        # fresh cache-bypassing re-resolution + retry
+                        # instead of burning the sample's quorum slot
+                        # on a stale record (ISSUE 11)
+                        rescued.add(uids[0])
+                        pending[
+                            asyncio.ensure_future(
+                                _rescue_single(endpoint, uids[0])
+                            )
+                        ] = (endpoint, [uids[0]])
                     continue
                 for uid in uids:
                     tensors = group_replies.get(uid)

@@ -283,16 +283,22 @@ class CachedAliveSet:
     """TTL cache over get_alive_experts — one discovery per window, not per
     batch (keeps routing off the dispatch hot path).
 
-    ``swr`` (stale-while-revalidate, ISSUE 9; also ``LAH_ALIVE_SWR=1``):
-    when the window expires, :meth:`get` serves the STALE set immediately
-    and refreshes in a background loop task instead of blocking the
-    dispatch on the discovery lookup.  Under churn a DHT lookup can
-    stall for seconds behind dead-but-not-yet-evicted peers — with swr
-    that cost never lands on the dispatch path, and the one-window
-    staleness it trades for is exactly what the hedge/retry machinery
-    already covers.  Opt-in for now: tests and chaos scenarios that
-    reason about when a kill becomes visible assume the blocking
-    refresh; flipping the default is a follow-up (ROADMAP item 4)."""
+    ``swr`` (stale-while-revalidate, ISSUE 9): when the window expires,
+    :meth:`get` serves the STALE set immediately and refreshes in a
+    background loop task instead of blocking the dispatch on the
+    discovery lookup.  Under churn a DHT lookup can stall behind
+    dead-but-not-yet-evicted peers — with swr that cost never lands on
+    the dispatch path, and the one-window staleness it trades for is
+    exactly what the hedge/retry machinery already covers.  ON by
+    default since ISSUE 11 (refreshes are cheap now: record cache +
+    adaptive sub-second RPC timeouts); ``LAH_ALIVE_SWR=0`` or
+    ``swr=False`` restores the blocking refresh — tests and chaos
+    scenarios that reason about WHEN a kill becomes visible pin it.
+
+    A ``force_refresh`` get always blocks on a fresh lookup, and asks a
+    DHT-backed source to bypass its record cache too
+    (``get_alive_experts_fresh``) — the authoritative read the dispatch
+    retry path uses when a sole endpoint hard-fails."""
 
     def __init__(
         self,
@@ -305,13 +311,20 @@ class CachedAliveSet:
         self.prefix = prefix
         self.ttl = ttl
         if swr is None:
-            swr = os.environ.get("LAH_ALIVE_SWR", "0") not in ("0", "")
+            swr = os.environ.get("LAH_ALIVE_SWR", "1") != "0"
         self.swr = bool(swr)
         self._cached: Optional[dict[str, Endpoint]] = None
         self._stamp = 0.0
         self._refreshing: Optional[Any] = None  # in-flight background task
         self.stale_serves = 0
         self.refresh_failures = 0
+
+    async def _fetch(self, fresh: bool = False) -> dict[str, Endpoint]:
+        if fresh:
+            fetch_fresh = getattr(self.source, "get_alive_experts_fresh", None)
+            if fetch_fresh is not None:
+                return await fetch_fresh(self.prefix)
+        return await self.source.get_alive_experts(self.prefix)
 
     async def get(self, force_refresh: bool = False) -> dict[str, Endpoint]:
         now = time.monotonic()
@@ -328,7 +341,7 @@ class CachedAliveSet:
             if self._refreshing is not None and not self._refreshing.done():
                 self._refreshing.cancel()
             self._refreshing = None
-            self._cached = await self.source.get_alive_experts(self.prefix)
+            self._cached = await self._fetch(fresh=force_refresh)
             self._stamp = time.monotonic()
             return self._cached
         # stale-while-revalidate: hand back the stale set NOW; at most
@@ -343,7 +356,7 @@ class CachedAliveSet:
 
     async def _refresh_bg(self) -> None:
         try:
-            alive = await self.source.get_alive_experts(self.prefix)
+            alive = await self._fetch()
         except asyncio.CancelledError:
             raise
         except Exception as e:

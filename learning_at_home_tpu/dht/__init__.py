@@ -33,18 +33,106 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Optional, Sequence
+import os
+import time
+import weakref
+from typing import Any, Optional, Sequence
 
 from learning_at_home_tpu.dht.node import DHTNode
 from learning_at_home_tpu.dht.routing import DHTID, Endpoint
 from learning_at_home_tpu.dht.protocol import PLAIN_SUBKEY
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
+from learning_at_home_tpu.utils.metrics import registry as _metrics
 from learning_at_home_tpu.utils.timed_storage import get_dht_time
 from learning_at_home_tpu.client.routing import UID_DELIMITER, split_uid
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["DHT", "DHTNode", "DHTID"]
+
+_CACHE_HITS = _metrics.counter(
+    "lah_dht_cache_hits_total", "routing-record cache hits"
+)
+_CACHE_MISSES = _metrics.counter(
+    "lah_dht_cache_misses_total", "routing-record cache misses"
+)
+
+
+class _RecordCache:
+    """Per-key cache of iterative-lookup results (ISSUE 11).
+
+    Loop-confined to the DHT's BackgroundLoop — every reader reaches it
+    through :meth:`DHT._bridge`, so no lock is needed.  Three freshness
+    rules compose:
+
+    - a cached entry is served for at most ``ttl`` seconds (the window a
+      repeated ``get_alive_experts``/load-feed/telemetry read stops
+      costing a full lookup);
+    - each RECORD additionally honors its own expiration — an expired
+      subkey never comes out of the cache even mid-window, so DHT expiry
+      (the swarm's failure detector) is never blunted by caching;
+    - an EMPTY result is cached too (negative caching): a miss storm on
+      a dead prefix costs one lookup per window, not one per read.
+
+    Entries invalidate when this node observes a store for the key — its
+    own writes (read-your-writes) and inbound store RPCs landing in the
+    local replica (protocol ``on_store_observed``)."""
+
+    def __init__(self, ttl: float = 1.0, maxsize: int = 4096):
+        self.ttl = ttl
+        self.maxsize = maxsize
+        self._entries: dict[bytes, tuple[float, dict]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    @staticmethod
+    def _norm(key: str | bytes) -> bytes:
+        """Cache keys use the DHT's WIRE form — the 20-byte DHTID digest
+        — because protocol ``on_store_observed`` only ever sees wire keys;
+        normalizing facade reads (plaintext keys) to the same form is what
+        lets an inbound store invalidate the matching cached read.  A
+        20-byte ``bytes`` key is assumed to already be a digest."""
+        if isinstance(key, (bytes, bytearray)) and len(key) == 20:
+            return bytes(key)
+        return DHTID.from_key(key).to_bytes()
+
+    def get(self, key: str | bytes) -> Optional[dict]:
+        kb = self._norm(key)
+        entry = self._entries.get(kb)
+        if entry is None:
+            self.misses += 1
+            return None
+        stamp, records = entry
+        if time.monotonic() - stamp > self.ttl:
+            del self._entries[kb]
+            self.misses += 1
+            return None
+        now = get_dht_time()
+        fresh = {sk: (v, e) for sk, (v, e) in records.items() if e > now}
+        if records and not fresh:
+            # every cached record expired mid-window: drop the entry so
+            # the next read re-resolves instead of serving an empty view
+            # for the rest of the window
+            del self._entries[kb]
+            self.misses += 1
+            return None
+        self.hits += 1
+        return fresh
+
+    def put(self, key: str | bytes, records: dict) -> None:
+        if self.ttl <= 0:
+            return
+        kb = self._norm(key)
+        if kb not in self._entries and len(self._entries) >= self.maxsize:
+            # evict the oldest-inserted entry: O(1) and good enough for a
+            # cache whose entries live ~one TTL window anyway
+            del self._entries[next(iter(self._entries))]
+        self._entries[kb] = (time.monotonic(), dict(records))
+
+    def invalidate(self, key: str | bytes) -> None:
+        if self._entries.pop(self._norm(key), None) is not None:
+            self.invalidations += 1
 
 
 def uid_prefixes(uid: str) -> list[str]:
@@ -70,8 +158,12 @@ class DHT:
         initial_peers: Sequence[Endpoint] = (),
         host: str = "127.0.0.1",
         port: int = 0,
+        cache_ttl: Optional[float] = None,
         **node_kwargs,
     ):
+        if cache_ttl is None:
+            cache_ttl = float(os.environ.get("LAH_DHT_CACHE_TTL", "1.0"))
+        self.record_cache = _RecordCache(ttl=cache_ttl)
         self._loop = BackgroundLoop(name="lah-dht")
         try:
             self.node: DHTNode = self._loop.run(
@@ -83,6 +175,35 @@ class DHT:
         except BaseException:
             self._loop.shutdown()  # don't leak the loop thread on failed init
             raise
+        # inbound stores landing in our local replica invalidate cached
+        # reads of that key (both callbacks run on the lah-dht loop)
+        self.node.protocol.on_store_observed = self.record_cache.invalidate
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        """Scrape-time collector for this handle's DHT series (weakref —
+        pruned automatically once the DHT is garbage-collected)."""
+        ref = weakref.ref(self)
+
+        def _collect() -> Optional[dict]:
+            dht = ref()
+            if dht is None:
+                return None
+            out = {
+                "lah_dht_record_cache_entries": float(
+                    len(dht.record_cache._entries)
+                ),
+                "lah_dht_record_cache_invalidations_total": float(
+                    dht.record_cache.invalidations
+                ),
+            }
+            times = sorted(dht.node.lookup_times)
+            if times:
+                idx = min(len(times) - 1, int(0.99 * len(times)))
+                out["lah_dht_lookup_p99_ms"] = 1000.0 * times[idx]
+            return out
+
+        _metrics.register_collector(f"dht-{id(self)}", _collect)
 
     @property
     def endpoint(self) -> Endpoint:
@@ -116,41 +237,47 @@ class DHT:
         uids: Sequence[str],
         endpoint: Endpoint,
         expiration: float = 60.0,
+        extra_records: Sequence[tuple] = (),
     ) -> int:
-        return await self._bridge(self._declare(uids, endpoint, expiration))
+        """``extra_records`` — ``(key, value, expiration_delta, subkey)``
+        tuples (the generic :meth:`store` signature) — ride the SAME
+        per-peer store bundles as the expert records, so a server
+        heartbeat's telemetry/load/wanted ads cost zero extra RPCs."""
+        return await self._bridge(
+            self._declare(uids, endpoint, expiration, extra_records)
+        )
 
-    async def _declare(self, uids, endpoint, expiration) -> int:
+    async def _declare(self, uids, endpoint, expiration, extra_records=()) -> int:
         """Returns how many of ``uids`` had their full record stored.
 
-        Prefix records are grouped by key: one iterative lookup + one
-        batched store per distinct prefix, not one per (uid, prefix) — for
-        a 256-expert server the heartbeat is a handful of lookups, not
-        hundreds.
+        All records — full uid records, prefix records, and any
+        ``extra_records`` — go through ONE :meth:`DHTNode.store_many`
+        call: one iterative lookup per distinct key, then one multi-key
+        store RPC per destination peer (ISSUE 11).  For a 256-expert
+        server the heartbeat is a handful of per-peer bundles, not a
+        per-key store storm.
 
         Subkeys carry the declaring endpoint (replica-aware scheme, see
         module docstring): N servers hosting one uid coexist as N subkey
         records under the same keys, each expiring on its own heartbeat —
         a dead replica vanishes without taking the uid down."""
-        expires_at = get_dht_time() + expiration
+        now = get_dht_time()
+        expires_at = now + expiration
         value = [endpoint[0], int(endpoint[1])]
         ep_key = f"{endpoint[0]}:{int(endpoint[1])}"
-        by_prefix: dict[str, list] = {}
+        entries: list[tuple] = [
+            (uid, f"@{ep_key}", value, expires_at) for uid in uids
+        ]
+        n_uids = len(entries)
         for uid in uids:
             for prefix in uid_prefixes(uid):
-                by_prefix.setdefault(prefix, []).append(
-                    (f"{uid}@{ep_key}", value, expires_at)
-                )
-        results = await asyncio.gather(
-            *(
-                self.node.store(uid, value, expires_at, f"@{ep_key}")
-                for uid in uids
-            ),
-            *(
-                self.node.store_batch(prefix, entries)
-                for prefix, entries in by_prefix.items()
-            ),
-        )
-        return sum(bool(r) for r in results[: len(uids)])
+                entries.append((prefix, f"{uid}@{ep_key}", value, expires_at))
+        for key, xvalue, delta, subkey in extra_records:
+            entries.append((key, subkey, xvalue, now + float(delta)))
+        acks = await self.node.store_many(entries)
+        for key, _sk, _v, _e in entries:
+            self.record_cache.invalidate(key)
+        return sum(acks[:n_uids])
 
     async def get_experts(
         self, uids: Sequence[str]
@@ -168,14 +295,55 @@ class DHT:
         heartbeat (``telemetry.<prefix>`` records, utils/telemetry.py)
         and other non-expert key families publish through this."""
         return await self._bridge(
-            self.node.store(
-                key, value, get_dht_time() + expiration_delta, subkey
-            )
+            self._store(key, value, expiration_delta, subkey)
         )
 
-    async def get(self, key) -> dict:
-        """Generic async get (fresh subkey records), loop-agnostic."""
-        return await self._bridge(self.node.get(key))
+    async def _store(self, key, value, expiration_delta, subkey) -> bool:
+        ok = await self.node.store(
+            key, value, get_dht_time() + expiration_delta, subkey
+        )
+        self.record_cache.invalidate(key)  # read-your-writes
+        return ok
+
+    async def store_many(
+        self, records: Sequence[tuple[Any, Any, float, str]]
+    ) -> list[bool]:
+        """Bundle store: ``(key, value, expiration_delta, subkey)`` per
+        record, keys may differ — one store RPC per destination peer for
+        the whole bundle (:meth:`DHTNode.store_many`).  Returns one ack
+        per record, positionally."""
+        return await self._bridge(self._store_many(records))
+
+    async def _store_many(self, records) -> list[bool]:
+        now = get_dht_time()
+        entries = [
+            (key, subkey, value, now + float(delta))
+            for key, value, delta, subkey in records
+        ]
+        acks = await self.node.store_many(entries)
+        for key, _sk, _v, _e in entries:
+            self.record_cache.invalidate(key)
+        return acks
+
+    async def get(self, key, bypass_cache: bool = False) -> dict:
+        """Generic async get (fresh subkey records), loop-agnostic.
+        Served from the routing-record cache within its TTL window unless
+        ``bypass_cache`` forces a real iterative lookup."""
+        return await self._bridge(self._cached_get(key, bypass_cache))
+
+    async def _cached_get(self, key, bypass_cache: bool = False) -> dict:
+        """All facade reads funnel here (runs on the lah-dht loop — the
+        cache is loop-confined).  A bypass read still refreshes the
+        cache, so a forced re-resolution benefits the next reader."""
+        if not bypass_cache and self.record_cache.ttl > 0:
+            cached = self.record_cache.get(key)
+            if cached is not None:
+                _CACHE_HITS.inc()
+                return cached
+            _CACHE_MISSES.inc()
+        records = await self.node.get(key)
+        self.record_cache.put(key, records)
+        return records
 
     @staticmethod
     def _parse_endpoint(value) -> Optional[Endpoint]:
@@ -193,7 +361,7 @@ class DHT:
         replicated uid the first replica in deterministic (sorted-subkey)
         order is returned — callers that want the full set use
         ``get_alive_experts`` on the uid's prefix."""
-        records = await asyncio.gather(*(self.node.get(uid) for uid in uids))
+        records = await asyncio.gather(*(self._cached_get(uid) for uid in uids))
         out: dict[str, Optional[Endpoint]] = {}
         for uid, rec in zip(uids, records):
             out[uid] = None
@@ -209,10 +377,20 @@ class DHT:
 
     # ---- ExpertSource protocol (used by RemoteMixtureOfExperts) ----
 
-    async def get_alive_experts(self, prefix: str) -> dict[str, Endpoint]:
-        return await self._bridge(self._get_alive(prefix))
+    async def get_alive_experts(
+        self, prefix: str, bypass_cache: bool = False
+    ) -> dict[str, Endpoint]:
+        return await self._bridge(self._get_alive(prefix, bypass_cache))
 
-    async def _get_alive(self, prefix: str) -> dict:
+    async def get_alive_experts_fresh(self, prefix: str) -> dict[str, Endpoint]:
+        """Cache-bypassing alive read: a full iterative lookup NOW.  The
+        authoritative path for consumers that must observe a kill the
+        moment its record expires (CachedAliveSet force-refresh, the
+        sole-endpoint dispatch retry) — the record cache must not add a
+        staleness window on top of the record TTL there."""
+        return await self._bridge(self._get_alive(prefix, bypass_cache=True))
+
+    async def _get_alive(self, prefix: str, bypass_cache: bool = False) -> dict:
         """uid → endpoint (single hoster) or tuple-of-endpoints (replica
         set, sorted for determinism).  Subkey forms, newest first:
 
@@ -222,7 +400,7 @@ class DHT:
           queries ``ffn.7`` directly);
         - bare uid — legacy prefix entry from an old build.
         """
-        records = await self.node.get(prefix)
+        records = await self._cached_get(prefix, bypass_cache)
         eps: dict[str, list] = {}
         for subkey, (v, _) in records.items():
             endpoint = self._parse_endpoint(v)
@@ -256,7 +434,7 @@ class DHT:
         return await self._bridge(self._first_k_active(prefixes, k))
 
     async def _first_k_active(self, prefixes, k) -> dict[str, bool]:
-        records = await asyncio.gather(*(self.node.get(p) for p in prefixes))
+        records = await asyncio.gather(*(self._cached_get(p) for p in prefixes))
         return {
             p: any(sk != PLAIN_SUBKEY for sk in rec)
             for p, rec in zip(prefixes, records)
@@ -272,9 +450,8 @@ class DHT:
 
     def store_sync(self, key, value, expiration_delta: float, subkey: str = PLAIN_SUBKEY) -> bool:
         return self._loop.run(
-            self.node.store(key, value, get_dht_time() + expiration_delta, subkey),
-            timeout=60,
+            self._store(key, value, expiration_delta, subkey), timeout=60
         )
 
-    def get_sync(self, key) -> dict:
-        return self._loop.run(self.node.get(key), timeout=60)
+    def get_sync(self, key, bypass_cache: bool = False) -> dict:
+        return self._loop.run(self._cached_get(key, bypass_cache), timeout=60)

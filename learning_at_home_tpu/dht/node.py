@@ -16,15 +16,30 @@ import logging
 import time
 from typing import Any, Iterable, Optional, Sequence
 
+from collections import deque
+
 from learning_at_home_tpu.dht.protocol import (
+    DEFAULT_RPC_TIMEOUT,
     DHTProtocol,
     DHTRecordStorage,
     PLAIN_SUBKEY,
 )
 from learning_at_home_tpu.dht.routing import DHTID, Endpoint, RoutingTable
+from learning_at_home_tpu.utils.metrics import registry as _metrics
 from learning_at_home_tpu.utils.timed_storage import DHTExpiration, get_dht_time
 
 logger = logging.getLogger(__name__)
+
+_LOOKUP_SECONDS = _metrics.histogram(
+    "lah_dht_lookup_seconds", "iterative lookup wall-clock",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+             0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+)
+_PINGS_SKIPPED = _metrics.counter(
+    "lah_dht_maintenance_pings_skipped_total",
+    "maintenance probes elided because regular traffic already proved "
+    "the peer alive (piggybacked liveness)",
+)
 
 
 class DHTNode:
@@ -34,10 +49,14 @@ class DHTNode:
         self,
         node_id: Optional[DHTID] = None,
         bucket_size: int = 20,
-        alpha: int = 3,
-        rpc_timeout: float = 3.0,
+        alpha: int = 6,
+        rpc_timeout: float = DEFAULT_RPC_TIMEOUT,
         max_records: Optional[int] = 65536,
     ):
+        # α = 6 (not the textbook 3) + the adaptive per-peer timeout
+        # (protocol.py): a wave is as slow as its slowest member, so a
+        # dead peer used to serialize the whole lookup for rpc_timeout —
+        # wider waves keep live progress flowing around it (ISSUE 11)
         self.node_id = node_id if node_id is not None else DHTID.generate()
         self.alpha = alpha
         self.bucket_size = bucket_size
@@ -58,6 +77,9 @@ class DHTNode:
         self._lookup_strikes: dict[DHTID, tuple[int, float]] = {}
         self._lookup_counter = itertools.count()
         self.routing_table.on_remove = self._on_table_remove
+        # recent lookup wall-clocks (the facade's lah_dht_lookup_p99 feed)
+        self.lookup_times: deque[float] = deque(maxlen=512)
+        self.maintenance_pings_skipped = 0
 
     @classmethod
     async def create(
@@ -156,9 +178,20 @@ class DHTNode:
                     oldest = bucket.oldest
                     if oldest is not None:
                         nid, endpoint = oldest
+                        heard = self.routing_table.last_heard.get(nid)
+                        if (
+                            heard is not None
+                            and time.monotonic() - heard <= period
+                        ):
+                            # piggybacked liveness (ISSUE 11): a reply or
+                            # inbound request within the last period IS a
+                            # ping — under regular heartbeat/lookup
+                            # traffic, explicit probes mostly disappear
+                            self.maintenance_pings_skipped += 1
+                            _PINGS_SKIPPED.inc()
                         # two strikes: a single timed-out ping (GC pause,
                         # transient congestion) must not shrink the table
-                        if (
+                        elif (
                             await self.protocol.call_ping(endpoint) is None
                             and await self.protocol.call_ping(endpoint) is None
                         ):
@@ -217,6 +250,7 @@ class DHTNode:
         self, target: DHTID, find_value: bool
     ) -> tuple[dict[str, tuple[Any, DHTExpiration]], list[tuple[DHTID, Endpoint]]]:
         lookup_id = next(self._lookup_counter)
+        lookup_t0 = time.monotonic()
         key_bytes = target.to_bytes()
         # seed with 2k neighbors, not k: a k-sized seed drawn from a
         # sparse table can lie entirely inside one local cluster, and the
@@ -286,6 +320,9 @@ class DHTNode:
             if all(nid in queried for nid in closest):
                 break
 
+        elapsed = time.monotonic() - lookup_t0
+        self.lookup_times.append(elapsed)
+        _LOOKUP_SECONDS.observe(elapsed)
         nearest = sorted(responded.items(), key=lambda kv: int(kv[0]) ^ int(target))
         return records, nearest[: self.bucket_size]
 
@@ -314,34 +351,77 @@ class DHTNode:
         """Write many subkeys of ONE key with a single iterative lookup and
         one batched store RPC per neighbor (the heartbeat hot path: all
         experts under a shared prefix key go out in one call)."""
+        acks = await self.store_many([(key, sk, v, e) for sk, v, e in entries])
+        ok: dict[str, bool] = {}
+        for (sk, _, _), a in zip(entries, acks):
+            ok[sk] = ok.get(sk, False) or a
+        return ok
+
+    async def store_many(
+        self,
+        entries: Sequence[tuple[str | bytes, str, Any, DHTExpiration]],
+    ) -> list[bool]:
+        """Write a bundle of (key, subkey, value, expiration) records —
+        keys may DIFFER — with one iterative lookup per distinct key and
+        then ONE store RPC per destination peer carrying every item that
+        peer should hold (ISSUE 11: the server heartbeat's expert +
+        telemetry + load + wanted records coalesce into a handful of
+        per-peer bundles instead of a per-key store storm).  Returns one
+        ack per entry, positionally."""
         from learning_at_home_tpu.dht.protocol import MAX_STORE_ITEMS
 
-        target = DHTID.from_key(key)
-        nearest = await self.find_nearest_nodes(target)
-        items = [(target.to_bytes(), sk, v, e) for sk, v, e in entries]
-        # serving nodes cap items per store RPC; chunk client-side so a
-        # >1024-expert declaration is never silently truncated
-        chunks = [
-            items[i : i + MAX_STORE_ITEMS]
-            for i in range(0, len(items), MAX_STORE_ITEMS)
-        ]
-        results = await asyncio.gather(
-            *(
-                self.protocol.call_store(ep, chunk)
-                for _, ep in nearest
-                for chunk in chunks
-            )
+        if not entries:
+            return []
+        wire_keys: list[bytes] = []
+        targets: dict[bytes, DHTID] = {}
+        by_key: dict[bytes, list[int]] = {}
+        for i, (key, _sk, _v, _e) in enumerate(entries):
+            target = DHTID.from_key(key)
+            kb = target.to_bytes()
+            wire_keys.append(kb)
+            targets.setdefault(kb, target)
+            by_key.setdefault(kb, []).append(i)
+
+        key_order = list(by_key)
+        nearest_per_key = await asyncio.gather(
+            *(self.find_nearest_nodes(targets[kb]) for kb in key_order)
         )
-        ok = {sk: any(r is not None and r.get(sk, False) for r in results)
-              for sk, _, _ in entries}
-        # replicate locally when we are within the k closest (or swarm is tiny)
-        if len(nearest) < self.bucket_size or any(
-            int(self.node_id) ^ int(target) < int(nid) ^ int(target)
-            for nid, _ in nearest
-        ):
-            for sk, v, e in entries:
-                if self.storage.store(target.to_bytes(), sk, v, e):
-                    ok[sk] = True
+        ok = [False] * len(entries)
+        per_peer: dict[Endpoint, list[int]] = {}
+        for kb, nearest in zip(key_order, nearest_per_key):
+            idxs = by_key[kb]
+            for _, ep in nearest:
+                per_peer.setdefault(ep, []).extend(idxs)
+            # replicate locally when we are within the k closest of this
+            # key (or the swarm is tiny)
+            target = targets[kb]
+            if len(nearest) < self.bucket_size or any(
+                int(self.node_id) ^ int(target) < int(nid) ^ int(target)
+                for nid, _ in nearest
+            ):
+                for i in idxs:
+                    _, sk, v, e = entries[i]
+                    if self.storage.store(kb, sk, v, e):
+                        ok[i] = True
+
+        async def store_to(ep: Endpoint, idxs: list[int]) -> None:
+            # serving nodes cap items per store RPC; chunk client-side so
+            # a >1024-record bundle is never silently truncated
+            for c in range(0, len(idxs), MAX_STORE_ITEMS):
+                chunk = idxs[c : c + MAX_STORE_ITEMS]
+                items = [
+                    (wire_keys[i], entries[i][1], entries[i][2], entries[i][3])
+                    for i in chunk
+                ]
+                acks = await self.protocol.call_store_items(ep, items)
+                if acks is not None:
+                    for i, a in zip(chunk, acks):
+                        if a:
+                            ok[i] = True
+
+        await asyncio.gather(
+            *(store_to(ep, idxs) for ep, idxs in per_peer.items())
+        )
         return ok
 
     async def get(

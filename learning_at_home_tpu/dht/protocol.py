@@ -25,10 +25,15 @@ from typing import Any, Optional
 
 from learning_at_home_tpu.dht.routing import DHTID, Endpoint, RoutingTable
 from learning_at_home_tpu.utils.connection import PoolRegistry
+from learning_at_home_tpu.utils.metrics import registry as _metrics
 from learning_at_home_tpu.utils.serialization import (
+    WireTensors,
+    pack_frames,
     pack_message,
+    peek_header,
     recv_frame,
     send_frame,
+    send_frame_parts,
     unpack_message,
 )
 from learning_at_home_tpu.utils.timed_storage import (
@@ -42,6 +47,28 @@ logger = logging.getLogger(__name__)
 PLAIN_SUBKEY = ""
 MAX_STORE_ITEMS = 1024  # per store RPC; a 256-expert heartbeat uses ~257
 MAX_KEY_BYTES = 512  # uids/prefixes are short; reject absurd keys
+
+# Adaptive RPC timeout (ISSUE 11): per-peer timeout = MULT × that peer's
+# RTT EMA (the pool already tracks it), clamped to [FLOOR, rpc_timeout].
+# ``rpc_timeout`` is thus the CEILING a never-measured or flaky peer can
+# cost, not the price every dead-peer probe pays — the fixed 3 s default
+# it replaces is what let dead DHT peers stall dispatch-path alive
+# refreshes for seconds (PR 9's ``--dht-rpc-timeout`` workaround).
+# Timeouts fold into the RTT EMA (utils/connection.py latency signals),
+# so a peer that outgrows its budget raises its own budget next call.
+DEFAULT_RPC_TIMEOUT = 0.8
+ADAPTIVE_TIMEOUT_FLOOR = 0.05
+ADAPTIVE_TIMEOUT_MULT = 4.0
+
+# client-side DHT traffic series (docs/OBSERVABILITY.md)
+_RPCS_TOTAL = _metrics.counter(
+    "lah_dht_rpcs_total", "DHT client RPCs issued, by type"
+)
+_BATCHED_KEYS = _metrics.histogram(
+    "lah_dht_batched_keys_per_store",
+    "distinct keys coalesced into one outgoing store RPC",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
 
 
 class DHTRecordStorage:
@@ -89,18 +116,30 @@ class DHTProtocol:
         node_id: DHTID,
         routing_table: RoutingTable,
         storage: DHTRecordStorage,
-        rpc_timeout: float = 3.0,
+        rpc_timeout: float = DEFAULT_RPC_TIMEOUT,
     ):
         self.node_id = node_id
         self.routing_table = routing_table
         self.storage = storage
-        self.rpc_timeout = rpc_timeout
+        self.rpc_timeout = rpc_timeout  # adaptive-timeout CEILING
         self.listen_port: Optional[int] = None  # set by DHTNode after bind
-        # v1-pinned: DHT handlers speak their own message schema, not the
-        # tensor-RPC ``hello`` — probing them would break the connection
+        # v2-negotiated since ISSUE 11: the serve loop answers ``hello``
+        # and echoes request ids, so one socket per peer carries many
+        # in-flight calls (lookup waves, batched stores).  Peers from
+        # builds whose DHT handlers predate ``hello`` are NOT reachable
+        # from this client (docs/PROTOCOL.md, "DHT traffic").
         self._pools = PoolRegistry(
-            max_connections_per_endpoint=2, negotiate_v2=False
+            max_connections_per_endpoint=2, negotiate_v2=True
         )
+        # plain-int traffic counters (per-protocol; the process-wide
+        # ``lah_dht_*`` series aggregate via utils/metrics).  Tests and
+        # the swarm simulator read these directly for A/B assertions.
+        self.rpcs_sent: dict[str, int] = {}
+        self.rpcs_served: dict[str, int] = {}
+        # called with each stored key (bytes) when an INBOUND store RPC
+        # lands in our storage — the facade's record cache invalidates on
+        # it so a cached read never outlives an observed overwrite
+        self.on_store_observed: Optional[Any] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._handler_tasks: set[asyncio.Task] = set()
 
@@ -131,11 +170,29 @@ class DHTProtocol:
                     payload = await recv_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break
-                msg_type, _, meta = unpack_message(payload)
+                msg_type, rid = peek_header(payload)
+                _, _, meta = unpack_message(payload)
+                if msg_type == "hello":
+                    # v2 negotiation (utils/connection.py): the DHT
+                    # speaks mux (rid-tagged replies over one socket)
+                    # but not codec — control frames carry no tensors
+                    feats = [
+                        f for f in (meta.get("features") or []) if f == "mux"
+                    ]
+                    # lah-lint: ignore[R1] tiny once-per-connection frame
+                    hello_ok = pack_message("hello_ok", meta={"features": feats})
+                    await send_frame(writer, hello_ok)
+                    continue
                 reply = self._serve(msg_type, meta, peer_host)
+                # Serving is serial per connection (requests are small
+                # sync dict ops), but replies echo the request id so a
+                # mux client may pipeline freely.
                 # lah-lint: ignore[R1] DHT control plane: replies are
                 # small msgpack maps (routing records), never tensor bytes
-                await send_frame(writer, pack_message("r", meta=reply))
+                await send_frame_parts(
+                    writer,
+                    pack_frames("r", WireTensors.prepare(), reply, rid=rid),
+                )
         except Exception:
             logger.exception("DHT handler error from %s", peer_host)
         finally:
@@ -146,13 +203,17 @@ class DHTProtocol:
         sender_id = DHTID.from_bytes(meta["from"])
         sender_port = int(meta["port"])
         self.routing_table.add_or_update_node(sender_id, (peer_host, sender_port))
+        self.rpcs_served[msg_type] = self.rpcs_served.get(msg_type, 0) + 1
 
         if msg_type == "ping":
             return {"node_id": self.node_id.to_bytes()}
         if msg_type == "store":
             # peer-supplied batch: bound item count and key/subkey sizes so
-            # one malicious frame can't stuff unbounded state
-            ok = {}
+            # one malicious frame can't stuff unbounded state.  Items may
+            # mix DIFFERENT keys (ISSUE 11: one store RPC per destination
+            # peer per heartbeat carries a whole record bundle).
+            ok: dict = {}
+            ok_list: list[bool] = []
             for key, subkey, value, expiration in meta["items"][:MAX_STORE_ITEMS]:
                 # type-check BEFORE bytes(): bytes(10**12) would try to
                 # allocate a terabyte of zeros from one malicious frame
@@ -161,10 +222,18 @@ class DHTProtocol:
                         or len(key) > MAX_KEY_BYTES \
                         or len(subkey) > MAX_KEY_BYTES:
                     ok[str(subkey)[:64]] = False
+                    ok_list.append(False)
                     continue
                 key = key.encode() if isinstance(key, str) else bytes(key)
-                ok[subkey] = self.storage.store(key, subkey, value, float(expiration))
-            return {"ok": ok}
+                good = self.storage.store(key, subkey, value, float(expiration))
+                ok[subkey] = good
+                ok_list.append(good)
+                if good and self.on_store_observed is not None:
+                    self.on_store_observed(key)
+            # ``ok`` (subkey-keyed) predates multi-key bundles, where two
+            # items sharing a subkey under different keys would collide —
+            # ``ok_list`` acks per ITEM, positionally
+            return {"ok": ok, "ok_list": ok_list}
         if msg_type == "find_node":
             return {"peers": self._nearest(meta["key"])}
         if msg_type == "find_value":
@@ -186,16 +255,41 @@ class DHTProtocol:
 
     # ---------------- client side ----------------
 
+    def timeout_for(self, endpoint: Endpoint) -> float:
+        """Per-peer adaptive timeout: MULT × the pool's RTT EMA, clamped
+        to [ADAPTIVE_TIMEOUT_FLOOR, rpc_timeout].  A peer never contacted
+        (or never successfully) pays the ceiling — which is also the hard
+        bound a dead peer can stall any single wave."""
+        pool = self._pools.peek(endpoint)
+        if pool is not None and pool.rtt_ema is not None:
+            return min(
+                max(ADAPTIVE_TIMEOUT_MULT * pool.rtt_ema,
+                    ADAPTIVE_TIMEOUT_FLOOR),
+                self.rpc_timeout,
+            )
+        return self.rpc_timeout
+
     async def _call(self, endpoint: Endpoint, msg_type: str, meta: dict) -> Optional[dict]:
         meta = {**meta, "from": self.node_id.to_bytes(), "port": self.listen_port}
+        self.rpcs_sent[msg_type] = self.rpcs_sent.get(msg_type, 0) + 1
+        _RPCS_TOTAL.inc(type=msg_type)
         try:
-            _, reply = await self._pools.get(endpoint).rpc(
-                msg_type, (), meta, timeout=self.rpc_timeout
-            )
-            return reply
+            return await self._transport(endpoint, msg_type, meta)
         except Exception as e:
             logger.debug("DHT rpc %s to %s failed: %s", msg_type, endpoint, e)
             return None
+
+    async def _transport(
+        self, endpoint: Endpoint, msg_type: str, meta: dict
+    ) -> Optional[dict]:
+        """One request/reply exchange on the wire.  The ONLY seam the
+        swarm simulator (experiments/dht_swarm_sim.py) overrides — every
+        envelope/accounting/timeout decision above it stays the real
+        code under simulation."""
+        _, reply = await self._pools.get(endpoint).rpc(
+            msg_type, (), meta, timeout=self.timeout_for(endpoint)
+        )
+        return reply
 
     async def call_ping(self, endpoint: Endpoint) -> Optional[DHTID]:
         reply = await self._call(endpoint, "ping", {})
@@ -210,10 +304,32 @@ class DHTProtocol:
         endpoint: Endpoint,
         items: list[tuple[bytes, str, Any, DHTExpiration]],
     ) -> Optional[dict]:
+        _BATCHED_KEYS.observe(len({it[0] for it in items}))
         reply = await self._call(
             endpoint, "store", {"items": [list(it) for it in items]}
         )
         return None if reply is None else reply.get("ok")
+
+    async def call_store_items(
+        self,
+        endpoint: Endpoint,
+        items: list[tuple[bytes, str, Any, DHTExpiration]],
+    ) -> Optional[list[bool]]:
+        """Multi-key bundle store with positional per-item acks (the
+        coalesced-heartbeat path; same wire RPC as :meth:`call_store`)."""
+        _BATCHED_KEYS.observe(len({it[0] for it in items}))
+        reply = await self._call(
+            endpoint, "store", {"items": [list(it) for it in items]}
+        )
+        if reply is None:
+            return None
+        acks = reply.get("ok_list")
+        if isinstance(acks, list) and len(acks) == len(items):
+            return [bool(a) for a in acks]
+        # peer predates ok_list: fall back to the subkey-keyed map (exact
+        # only when subkeys are unique within the bundle)
+        ok = reply.get("ok") or {}
+        return [bool(ok.get(sk, False)) for _, sk, _, _ in items]
 
     @staticmethod
     def _parse_peers(reply: dict) -> list[tuple[DHTID, Endpoint]]:

@@ -115,6 +115,12 @@ class RoutingTable:
         # table by ANY path — lets the owner drop per-node bookkeeping
         # (e.g. DHTNode's lookup strikes) that would otherwise leak
         self.on_remove: Optional[Callable[[DHTID], None]] = None
+        # piggybacked liveness: monotonic stamp of the last time we HEARD
+        # from each peer (inbound request or reply to our RPC).  Table
+        # maintenance reads this to skip probing peers whose regular
+        # traffic already proved them alive — the explicit ping is the
+        # fallback for quiet peers, not the common case.
+        self.last_heard: dict[DHTID, float] = {}
 
     def _bucket_index(self, node_id: int) -> int:
         for i, b in enumerate(self.buckets):
@@ -125,6 +131,13 @@ class RoutingTable:
     def add_or_update_node(self, node_id: DHTID, endpoint: Endpoint) -> None:
         if node_id == self.node_id:
             return
+        self.last_heard[node_id] = time.monotonic()
+        if len(self.last_heard) > 65536:
+            # stamps can reference peers parked-then-dropped from
+            # replacement lists (remove_node never fires for those); the
+            # cost of over-pruning is one redundant maintenance ping
+            for k in list(self.last_heard)[: len(self.last_heard) // 2]:
+                del self.last_heard[k]
         idx = self._bucket_index(node_id)
         bucket = self.buckets[idx]
         if bucket.add_or_update(node_id, endpoint):
@@ -136,6 +149,7 @@ class RoutingTable:
 
     def remove_node(self, node_id: DHTID) -> None:
         self.buckets[self._bucket_index(node_id)].remove(node_id)
+        self.last_heard.pop(node_id, None)
         if self.on_remove is not None:
             self.on_remove(node_id)
 

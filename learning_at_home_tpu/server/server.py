@@ -570,7 +570,37 @@ class Server:
         while True:
             try:
                 serving = self.lifecycle_state == lifecycle.SERVING
+                ttl = self.update_period * 2
+                # one record bundle per period (ISSUE 11): expert declares
+                # + telemetry + load + wanted ads coalesce into a single
+                # store_many — one multi-key store RPC per destination
+                # peer instead of a per-key store storm
+                extra: list[tuple] = []
+                if self.metrics_port is not None:
+                    # telemetry keeps heartbeating through the drain so
+                    # observers (lah_top) see DRAINING, not a dead peer
+                    extra.append((
+                        telemetry_key(self.telemetry_prefix),
+                        [self.endpoint[0], self.metrics_port, "server"],
+                        ttl, peer_id,
+                    ))
                 if serving:
+                    hot = self.hot_experts()
+                    extra.append((
+                        load_key(self.telemetry_prefix),
+                        {
+                            "q": float(self.runtime.queue_depth),
+                            "n": len(self.experts),
+                            "hot": hot,
+                        },
+                        ttl, ep_key,
+                    ))
+                    for uid, ema in hot.items():
+                        extra.append((
+                            replicas_wanted_key(self.telemetry_prefix),
+                            [ema, self.endpoint[0], self.port],
+                            ttl, uid,
+                        ))
                     # a DRAINING server stops re-declaring its experts
                     # (and its load/wanted records): the records it
                     # already published expire within one TTL and new
@@ -578,36 +608,10 @@ class Server:
                     # announcement (hedges cover the stale window)
                     await self.dht.declare_experts(
                         list(self.experts), self.endpoint,
-                        expiration=self.update_period * 2,
+                        expiration=ttl, extra_records=extra,
                     )
-                if self.metrics_port is not None:
-                    # telemetry keeps heartbeating through the drain so
-                    # observers (lah_top) see DRAINING, not a dead peer
-                    await self.dht.store(
-                        telemetry_key(self.telemetry_prefix),
-                        [self.endpoint[0], self.metrics_port, "server"],
-                        expiration_delta=self.update_period * 2,
-                        subkey=peer_id,
-                    )
-                if serving:
-                    hot = self.hot_experts()
-                    await self.dht.store(
-                        load_key(self.telemetry_prefix),
-                        {
-                            "q": float(self.runtime.queue_depth),
-                            "n": len(self.experts),
-                            "hot": hot,
-                        },
-                        expiration_delta=self.update_period * 2,
-                        subkey=ep_key,
-                    )
-                    for uid, ema in hot.items():
-                        await self.dht.store(
-                            replicas_wanted_key(self.telemetry_prefix),
-                            [ema, self.endpoint[0], self.port],
-                            expiration_delta=self.update_period * 2,
-                            subkey=uid,
-                        )
+                elif extra:
+                    await self.dht.store_many(extra)
             except Exception:
                 logger.exception("declare_experts heartbeat failed")
             await asyncio.sleep(self.update_period)

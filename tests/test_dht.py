@@ -404,3 +404,207 @@ def test_lookup_strikes_cleared_when_node_leaves_table():
     assert peer in node._lookup_strikes
     node.routing_table.remove_node(peer)  # the maintenance path
     assert peer not in node._lookup_strikes
+
+
+# ---------------- routing-record cache (ISSUE 11) ----------------
+
+
+def test_record_cache_honors_record_expiry():
+    """A cached entry is filtered by each RECORD's own expiration: an
+    expired subkey never comes out of the cache mid-TTL-window, so DHT
+    expiry (the swarm's failure detector) is never blunted by caching."""
+    from learning_at_home_tpu.dht import _RecordCache
+
+    cache = _RecordCache(ttl=30.0)
+    now = get_dht_time()
+    cache.put("k", {"soon": (1, now + 0.2), "later": (2, now + 30)})
+    assert set(cache.get("k")) == {"soon", "later"}
+    time.sleep(0.25)
+    assert set(cache.get("k")) == {"later"}  # 'soon' expired mid-window
+
+
+def test_record_cache_all_expired_is_miss():
+    """When EVERY cached record expires, the entry drops entirely — the
+    next read re-resolves instead of serving an empty view for the rest
+    of the TTL window."""
+    from learning_at_home_tpu.dht import _RecordCache
+
+    cache = _RecordCache(ttl=30.0)
+    cache.put("k", {"a": (1, get_dht_time() + 0.1)})
+    time.sleep(0.15)
+    assert cache.get("k") is None
+    assert cache.misses == 1
+
+
+def test_record_cache_negative_caching_and_ttl():
+    """An EMPTY lookup result is cached too (one lookup per window for a
+    miss storm on a dead prefix), and ages out at the TTL like any entry."""
+    from learning_at_home_tpu.dht import _RecordCache
+
+    cache = _RecordCache(ttl=0.2)
+    cache.put("missing", {})
+    assert cache.get("missing") == {}  # negative hit: no lookup needed
+    assert cache.hits == 1
+    time.sleep(0.25)
+    assert cache.get("missing") is None  # window over: re-resolve
+
+
+def test_record_cache_invalidate_matches_wire_key():
+    """Cache keys are the DHT wire form (DHTID digest): protocol
+    ``on_store_observed`` only ever sees wire keys, so an inbound store
+    must invalidate the entry cached under the PLAINTEXT key."""
+    from learning_at_home_tpu.dht import _RecordCache
+
+    cache = _RecordCache(ttl=30.0)
+    cache.put("ffn", {"x": (1, get_dht_time() + 30)})
+    cache.invalidate(DHTID.from_key("ffn").to_bytes())  # wire-form key
+    assert cache.get("ffn") is None
+    assert cache.invalidations == 1
+
+
+def test_dht_cache_hit_serves_without_rpcs_and_bypass_forces_lookup():
+    dht1 = DHT()
+    dht2 = DHT(initial_peers=[dht1.endpoint])
+    try:
+        dht1.declare_experts_sync(
+            ["ffn.0.0"], ("10.0.0.1", 9000), expiration=30
+        )
+        first = dht2.get_sync("ffn.0.0")
+        assert "@10.0.0.1:9000" in first
+        sent = sum(dht2.node.protocol.rpcs_sent.values())
+        assert dht2.get_sync("ffn.0.0") == first
+        assert sum(dht2.node.protocol.rpcs_sent.values()) == sent, (
+            "second read within the TTL window must be served from cache"
+        )
+        assert dht2.get_sync("ffn.0.0", bypass_cache=True) == first
+        assert sum(dht2.node.protocol.rpcs_sent.values()) > sent, (
+            "bypass_cache must run a real iterative lookup"
+        )
+    finally:
+        dht2.shutdown()
+        dht1.shutdown()
+
+
+def test_dht_cache_invalidated_by_own_store():
+    """Read-your-writes: this handle's own declare invalidates its cached
+    read, so the next read sees the new expert mid-TTL-window."""
+    dht1 = DHT(cache_ttl=30.0)
+    dht2 = DHT(initial_peers=[dht1.endpoint], cache_ttl=30.0)
+    try:
+        dht1.declare_experts_sync(
+            ["ffn.0.0"], ("10.0.0.1", 9000), expiration=30
+        )
+        assert set(dht2._loop.run(dht2._get_alive("ffn"))) == {"ffn.0.0"}
+        dht2.declare_experts_sync(
+            ["ffn.0.1"], ("10.0.0.2", 9000), expiration=30
+        )
+        alive = dht2._loop.run(dht2._get_alive("ffn"))
+        assert set(alive) == {"ffn.0.0", "ffn.0.1"}
+    finally:
+        dht2.shutdown()
+        dht1.shutdown()
+
+
+def test_dht_cache_invalidated_by_inbound_store():
+    """In a 2-node swarm both nodes hold every record, so a declare on
+    one lands an inbound store RPC on the other — whose cached read of
+    the prefix must invalidate (``on_store_observed``), not serve the
+    stale roster for the rest of a 30 s window."""
+    dht1 = DHT(cache_ttl=30.0)
+    dht2 = DHT(initial_peers=[dht1.endpoint], cache_ttl=30.0)
+    try:
+        dht1.declare_experts_sync(
+            ["ffn.0.0"], ("10.0.0.1", 9000), expiration=30
+        )
+        assert set(dht2._loop.run(dht2._get_alive("ffn"))) == {"ffn.0.0"}
+        dht1.declare_experts_sync(
+            ["ffn.0.1"], ("10.0.0.1", 9001), expiration=30
+        )
+        alive = dht2._loop.run(dht2._get_alive("ffn"))
+        assert set(alive) == {"ffn.0.0", "ffn.0.1"}, (
+            "inbound store did not invalidate the cached prefix read"
+        )
+    finally:
+        dht2.shutdown()
+        dht1.shutdown()
+
+
+# ---------------- batched multi-key store (ISSUE 11) ----------------
+
+
+def test_store_many_wire_parity_with_per_key_stores():
+    """The coalesced multi-key store bundle must land byte-for-byte the
+    same records (values AND expirations) as the per-key path — while
+    spending fewer store RPCs than one per (key, subkey)."""
+
+    async def main():
+        nodes = await make_swarm(6, bucket_size=4)
+        try:
+            exp = get_dht_time() + 30
+            batched = [
+                (f"bk.{i}", f"s{j}", [i, j], exp)
+                for i in range(3)
+                for j in range(2)
+            ]
+            sent0 = nodes[1].protocol.rpcs_sent.get("store", 0)
+            acks = await nodes[1].store_many(batched)
+            batched_rpcs = nodes[1].protocol.rpcs_sent.get("store", 0) - sent0
+            assert all(acks), acks
+
+            sent0 = nodes[2].protocol.rpcs_sent.get("store", 0)
+            for i in range(3):
+                for j in range(2):
+                    ok = await nodes[2].store(
+                        f"pk.{i}", [i, j], exp, subkey=f"s{j}"
+                    )
+                    assert ok
+            per_key_rpcs = nodes[2].protocol.rpcs_sent.get("store", 0) - sent0
+
+            for i in range(3):
+                b = await nodes[5].get(f"bk.{i}")
+                p = await nodes[5].get(f"pk.{i}")
+                assert set(b) == set(p) == {"s0", "s1"}, (i, b, p)
+                for j in range(2):
+                    assert b[f"s{j}"][0] == p[f"s{j}"][0] == [i, j]
+                    assert b[f"s{j}"][1] == p[f"s{j}"][1] == exp
+            # 6 per-(key,subkey) calls each fan to ~k peers; the bundle
+            # pays at most one store RPC per destination peer
+            assert batched_rpcs <= len(nodes) < per_key_rpcs, (
+                batched_rpcs, per_key_rpcs,
+            )
+        finally:
+            await teardown(nodes)
+
+    run(main())
+
+
+def test_dead_peer_alive_refresh_bounded_by_adaptive_ceiling():
+    """A cache-bypassing alive refresh with dead-but-not-yet-evicted DHT
+    peers must finish within ~one adaptive-timeout ceiling: each dead
+    contact costs at most ``rpc_timeout`` and a lookup wave contacts
+    alpha peers in parallel, so dead-peer stalls do not stack."""
+    dht1 = DHT(rpc_timeout=0.4)
+    dead = [
+        DHT(initial_peers=[dht1.endpoint], rpc_timeout=0.4) for _ in range(2)
+    ]
+    client = DHT(initial_peers=[dht1.endpoint], rpc_timeout=0.4)
+    try:
+        dht1.declare_experts_sync(
+            ["ffn.0.0", "ffn.1.0"], ("10.0.0.1", 9000), expiration=30
+        )
+        client.get_sync("ffn")  # lookups learn the soon-dead peers
+        for d in dead:
+            d.shutdown()
+        t0 = time.monotonic()
+        alive = client._loop.run(
+            client._get_alive("ffn", bypass_cache=True), timeout=30
+        )
+        elapsed = time.monotonic() - t0
+        assert set(alive) == {"ffn.0.0", "ffn.1.0"}
+        assert elapsed < 1.0, (
+            f"fresh alive refresh stalled {elapsed:.2f}s — dead peers must "
+            f"cost at most the adaptive ceiling (0.4s), paid in parallel"
+        )
+    finally:
+        client.shutdown()
+        dht1.shutdown()

@@ -622,9 +622,12 @@ def test_alive_cache_stale_while_revalidate():
     finally:
         loop.shutdown()
 
-    # swr off (the default): the historical blocking-refresh semantics
+    # swr defaults ON since ISSUE 11 (cheap refreshes); pinning
+    # swr=False restores the historical blocking-refresh semantics
+    # chaos tests that reason about kill visibility rely on
+    assert CachedAliveSet(Source(), "a", ttl=0.05).swr is True
     src2 = Source()
-    cache2 = CachedAliveSet(src2, "a", ttl=0.05)
+    cache2 = CachedAliveSet(src2, "a", ttl=0.05, swr=False)
     assert cache2.swr is False
     loop2 = BackgroundLoop(name="test-noswr")
     try:

@@ -420,3 +420,64 @@ def test_replica_sync_converges_diverged_replicas():
         reset_client_rpc()
         for d in (d_a, d_b, boot):
             d.shutdown()
+
+
+def test_sole_endpoint_rescue_fresh_lookup_retry():
+    """A NON-replicated uid whose only endpoint dies mid-record-TTL
+    (ISSUE 11): no hedge backup exists, so the dispatch must do ONE
+    cache-bypassing alive refresh, re-resolve the uid (simulating a
+    migrated host that re-declared within a heartbeat), and retry the
+    same prepared payload at the fresh endpoint — zero dropped samples,
+    bitwise-identical reply (both servers crc32-seed ``hdg.0``)."""
+    ctx_a, ctx_b = _replica_pair()
+    with ctx_a as (ep_a, srv_a), ctx_b as (ep_b, _):
+        source = StaticExpertSource({"hdg.0": ep_a})  # sole endpoint
+        # short forward_timeout: the half-open connection to the killed
+        # server HANGS (no RST) and only fails at the rpc timeout — the
+        # rescue triggers on that failure, not on a magic fast error
+        moe = RemoteMixtureOfExperts(
+            in_features=HID, grid_size=(1,), uid_prefix="hdg",
+            source=source, k_best=1, k_min=1, forward_timeout=2.0,
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        y0 = np.asarray(moe(_x(), gate))
+        srv_a.shutdown()
+        source.experts["hdg.0"] = ep_b  # the 'migrated' re-declaration
+        y1 = np.asarray(moe(_x(), gate))
+        np.testing.assert_allclose(y1, y0, atol=1e-5)
+        routing = moe.dispatch_stats()["routing"]
+        assert routing["fresh_retries"] >= 1, routing
+        assert routing["fresh_retry_wins"] >= 1, routing
+        assert moe.samples_dropped == 0
+
+
+def test_sole_endpoint_rescue_no_replacement_degrades():
+    """The rescue fires at most once per uid and, when the fresh lookup
+    finds no replacement (the static table still points at the corpse),
+    the sample degrades through the normal quorum path instead of
+    retrying forever."""
+    ctx_a = background_server(
+        hidden_dim=HID, expert_uids=["hdg.0"], optimizer=optax.sgd(0.0)
+    )
+    ctx_b = background_server(
+        hidden_dim=HID, expert_uids=["hdg.1"], optimizer=optax.sgd(0.0)
+    )
+    with ctx_a as (ep_a, srv_a), ctx_b as (ep_b, _):
+        source = StaticExpertSource({"hdg.0": ep_a, "hdg.1": ep_b})
+        # grace (timeout_after_k_min) must outlive forward_timeout here:
+        # hdg.1's fast reply meets the quorum and arms the grace period,
+        # and the hung call to the corpse only fails at forward_timeout —
+        # the rescue needs to fire inside that window to be observable
+        moe = RemoteMixtureOfExperts(
+            in_features=HID, grid_size=(2,), uid_prefix="hdg",
+            source=source, k_best=2, k_min=1, forward_timeout=2.0,
+            timeout_after_k_min=5.0,
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        jax.block_until_ready(moe(_x(), gate))
+        srv_a.shutdown()
+        jax.block_until_ready(moe(_x(), gate))  # hdg.1 alone meets k_min=1
+        routing = moe.dispatch_stats()["routing"]
+        assert routing["fresh_retries"] >= 1, routing
+        assert routing["fresh_retry_wins"] == 0, routing
+        assert moe.samples_dropped == 0

@@ -168,7 +168,51 @@ def smoke_worker() -> int:
     rc = lifecycle_smoke()
     if rc:
         return rc
+    rc = dht_smoke()
+    if rc:
+        return rc
     return slo_smoke()
+
+
+def dht_smoke() -> int:
+    """DHT control-plane gate (ISSUE 11): a 200-virtual-node simulated
+    swarm (in-process transport shim, real DHTNode/DHTProtocol code)
+    must join, survive two kill-and-replace churn rounds with lookup
+    hit-rate >= 0.99, and show the coalesced heartbeat cutting store
+    RPCs >= 4x vs the per-key baseline — in seconds, not minutes."""
+    import json as _json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "experiments/dht_swarm_sim.py",
+                "--sizes", "200", "--experts", "64",
+                "--churn-rounds", "2", "--lookups", "120", "--check",
+            ],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=int(os.environ.get("COLLECT_GATE_DHT_TIMEOUT_S", "180")),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: DHT swarm sim timed out", file=sys.stderr)
+        return 2
+    if r.returncode != 0 or "DHT_SWARM_SIM_OK" not in r.stdout:
+        print("collect_gate: FAIL — DHT swarm sim:", file=sys.stderr)
+        print(r.stdout[-1500:], file=sys.stderr)
+        print(r.stderr[-1500:], file=sys.stderr)
+        return r.returncode or 1
+    line = next(
+        (ln for ln in r.stdout.splitlines() if ln.startswith("{")), "{}"
+    )
+    rep = _json.loads(line)
+    print(
+        "DHT_SMOKE_OK nodes=200 "
+        f"hit_rate={rep['churn']['hit_rate']} "
+        f"store_reduction={rep['heartbeat']['reduction']}x "
+        f"join_mean_ms={rep['join']['mean_ms']}"
+    )
+    return 0
 
 
 def lifecycle_smoke() -> int:
@@ -691,10 +735,10 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # eight smokes now (client path, averaging, codec, telemetry+
-            # lah_top subprocess, replication, overlap, lifecycle, SLO
-            # churn harness): a wider bound than the gate's
-            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "1100")),
+            # nine smokes now (client path, averaging, codec, telemetry+
+            # lah_top subprocess, replication, overlap, lifecycle, DHT
+            # swarm sim, SLO churn harness): a wider bound than the gate's
+            timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "1200")),
         )
     except subprocess.TimeoutExpired:
         print("collect_gate: client-path smoke timed out", file=sys.stderr)
@@ -708,6 +752,7 @@ def run_smoke() -> int:
         or "REPLICA_SMOKE_OK" not in r.stdout
         or "OVERLAP_SMOKE_OK" not in r.stdout
         or "LIFECYCLE_SMOKE_OK" not in r.stdout
+        or "DHT_SMOKE_OK" not in r.stdout
         or "SLO_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
