@@ -233,7 +233,7 @@ def run_dht_sim_bench(deadline: int = 420, sizes: str = "128,512") -> dict | Non
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "ad58216"
+PREV_ROUND_REV = "ab9aead"
 
 
 def check_orphan_servers() -> dict | None:
@@ -415,6 +415,12 @@ def main() -> int:
         skw = run_skewed_routing_bench()
         if skw:
             result.update(skw)
+        # serving-gateway open-loop A/B (ISSUE 12): continuous batching
+        # vs sequential per-request serving at the rate that saturates
+        # the sequential arm — host/DCN tier like dispatch
+        gwb = run_gateway_bench()
+        if gwb:
+            result.update(gwb)
         # DHT control-plane series (ISSUE 11): host-side like dispatch;
         # the two-size series keeps the full-bench wall bounded — the
         # 1k-node run lives behind the standalone --dht-sim mode
@@ -1554,6 +1560,174 @@ def run_skewed_routing_bench(deadline: int = 300) -> dict | None:
     return result
 
 
+def gateway_worker() -> None:
+    """Serving-gateway open-loop A/B (ISSUE 12 acceptance): the SAME
+    swarm model behind two gateway shapes — sequential per-request
+    serving (``max_slots=1``: every stream owns the decoder alone) vs
+    continuous batching (``max_slots=8``: open-loop arrivals join the
+    running decode batch at token boundaries) — driven by the Poisson
+    loadgen at the offered rate that saturates the sequential arm.
+    Decode steps are wire-latency-bound (subprocess nop-expert servers
+    with injected reply latency, same isolation argument as the overlap
+    bench), so batching 8 streams into ONE pack-once dispatch per layer
+    multiplies served tokens/sec without multiplying per-step wall —
+    the continuous-batching win the gateway exists for.  Two more arms
+    probe admission control: half the saturation rate must shed nothing,
+    and 2x the batched arm's estimated capacity must shed with
+    well-formed retry-after replies and zero client-side crashes."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ.get("BENCH_DEADLINE_S", "420")), exit=True
+    )
+
+    import jax
+
+    from experiments.loadgen import run_load
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.gateway import Gateway, GatewayClient
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmDMoETransformerLM,
+        SwarmTransformerConfig,
+    )
+    from learning_at_home_tpu.utils.subproc import (
+        shutdown_procs,
+        spawn_expert_servers,
+    )
+
+    d_model, n_layers, seq = 16, 2, 32
+    vocab, prompt_len, max_new = 64, 6, 10
+    slots = int(os.environ.get("BENCH_GATEWAY_SLOTS", "8"))
+    duration = float(os.environ.get("BENCH_GATEWAY_DURATION", "8"))
+    latency = float(os.environ.get("BENCH_GATEWAY_LATENCY", "0.02"))
+
+    procs, ports = spawn_expert_servers(
+        REPO, "gwb", (latency,) * n_layers, d_model=d_model, num_experts=2,
+    )
+    out: dict = {
+        "gateway_slots": slots,
+        "gateway_arm_duration_s": duration,
+        "gateway_chaos_latency_s": latency,
+        "gateway_tokens_per_stream": max_new,
+    }
+    try:
+        source = StaticExpertSource({
+            f"gwb{layer}.{e}": ("127.0.0.1", ports[layer])
+            for layer in range(n_layers) for e in range(2)
+        })
+        cfg = SwarmTransformerConfig(
+            vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+            n_heads=4, seq_len=seq, grid_size=(2,), k_best=2, k_min=2,
+            uid_prefix="gwb", timeout_after_k_min=30.0,
+            forward_timeout=60.0, backward_timeout=60.0,
+            wire_codec="none", routing_cost_weight=0,
+        )
+        model = SwarmDMoETransformerLM(cfg, source)
+        params = model.init_params(jax.random.PRNGKey(0))
+
+        # sequential capacity, closed-loop: one stream at a time through
+        # a 1-slot gateway; its tokens/sec pins every open-loop rate below
+        with Gateway(model, params, max_slots=1, coalesce=True) as gw:
+            client = GatewayClient(gw.endpoint)
+            client.generate(list(range(1, prompt_len + 1)), max_new)  # warm
+            t0 = time.monotonic()
+            served = 0
+            for i in range(4):
+                r = client.generate([1 + i] * prompt_len, max_new)
+                served += len(r.get("tokens") or [])
+            seq_tps = served / (time.monotonic() - t0)
+        out["gateway_seq_closed_tokens_per_sec"] = round(seq_tps, 2)
+        # the offered rate that saturates the 1-slot arm: 3x its
+        # closed-loop request capacity (rho > 1, so the sequential arm's
+        # served tokens/sec plateaus at capacity while batching absorbs)
+        rate_sat = 3.0 * seq_tps / max_new
+        out["gateway_rate_sat_rps"] = round(rate_sat, 2)
+
+        def arm(label: str, max_slots: int, rate: float, seed: int) -> dict:
+            with Gateway(
+                model, params, max_slots=max_slots, coalesce=True
+            ) as gw:
+                GatewayClient(gw.endpoint).generate(
+                    list(range(1, prompt_len + 1)), 2
+                )  # warm the decode path before the clock starts
+                rep = run_load(
+                    gw.endpoint, rate_hz=rate, duration_s=duration,
+                    prompt_len=(prompt_len, prompt_len),
+                    max_new=(max_new, max_new), vocab=vocab, seed=seed,
+                )
+                co = gw.coalescer.stats()
+            return {
+                f"gateway_{label}_rate_rps": round(rate, 2),
+                f"gateway_{label}_tokens_per_sec": rep["tokens_per_sec"],
+                f"gateway_{label}_shed_fraction": rep["shed_fraction"],
+                f"gateway_{label}_ttft_p50_ms": rep["ttft_p50_ms"],
+                f"gateway_{label}_ttft_p99_ms": rep["ttft_p99_ms"],
+                f"gateway_{label}_itl_p99_ms": rep["itl_p99_ms"],
+                f"gateway_{label}_arrivals": rep["arrivals"],
+                f"gateway_{label}_completed": rep["completed"],
+                f"gateway_{label}_shed": rep["shed"],
+                f"gateway_{label}_shed_with_retry_after":
+                    rep["shed_with_retry_after"],
+                f"gateway_{label}_errors": rep["errors"],
+                f"gateway_{label}_crashes": rep["crashes"],
+                f"gateway_{label}_coalesced_dispatches":
+                    co["coalesced_dispatches_total"],
+            }
+
+        out.update(arm("seq_sat", 1, rate_sat, seed=1))
+        out.update(arm("cb_sat", slots, rate_sat, seed=1))
+        seq_tok = out["gateway_seq_sat_tokens_per_sec"]
+        out["gateway_cb_vs_seq_tokens_per_sec"] = (
+            round(out["gateway_cb_sat_tokens_per_sec"] / seq_tok, 2)
+            if seq_tok else None
+        )
+        # partial print first: an admission-arm failure must never
+        # forfeit the headline A/B (the acceptance observable)
+        print(json.dumps(out), flush=True)
+        out.update(arm("cb_half", slots, 0.5 * rate_sat, seed=2))
+        # 2x the batched arm's estimated request capacity (slots
+        # concurrent streams, each at the sequential per-stream rate)
+        rate_over = 2.0 * slots * seq_tps / max_new
+        out.update(arm("cb_over", slots, rate_over, seed=3))
+        out["gateway_cb_over_sheds_wellformed"] = bool(
+            out["gateway_cb_over_shed"] > 0
+            and out["gateway_cb_over_shed_with_retry_after"]
+            == out["gateway_cb_over_shed"]
+        )
+    finally:
+        shutdown_procs(procs)
+        reset_client_rpc()
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(out), flush=True)
+
+
+def run_gateway_bench(deadline: int = 420) -> dict | None:
+    """Gateway continuous-batching A/B in a scrubbed CPU subprocess
+    (host/DCN tier, accelerator-independent like the dispatch bench)."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_DEADLINE_S"] = str(deadline)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--gateway-worker"],
+            capture_output=True, text=True, timeout=deadline + 30,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        print("bench: gateway bench timed out", file=sys.stderr)
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        return _last_json_line(stdout)
+    result = _last_json_line(r.stdout)
+    if result is None:
+        print(f"bench: gateway bench rc={r.returncode}, no JSON\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+    return result
+
+
 def averaging_worker() -> None:
     """Trainer-side averaging microbench: two in-process peers run
     ``--avg-rounds`` DHT-matched all-reduce rounds over a trunk-sized
@@ -1661,6 +1835,9 @@ if __name__ == "__main__":
     if "--skewed-worker" in sys.argv:
         skewed_routing_worker()
         sys.exit(0)
+    if "--gateway-worker" in sys.argv:
+        gateway_worker()
+        sys.exit(0)
     if "--dht-sim" in sys.argv:
         # standalone DHT control-plane series (ISSUE 11): the full
         # 128/512/1024 simulated-swarm run with the hit-rate,
@@ -1669,6 +1846,14 @@ if __name__ == "__main__":
         print(json.dumps(_dht if _dht else {"error": "dht sim failed"}),
               flush=True)
         sys.exit(0 if _dht else 1)
+    if "--gateway" in sys.argv:
+        # standalone serving-gateway A/B (ISSUE 12): continuous batching
+        # vs sequential + the admission-control arms, in the same
+        # scrubbed subprocess the full bench uses
+        _gwb = run_gateway_bench()
+        print(json.dumps(_gwb if _gwb else {"error": "gateway bench failed"}),
+              flush=True)
+        sys.exit(0 if _gwb else 1)
     if "--skewed-routing" in sys.argv:
         # standalone latency-aware-routing A/B (ISSUE 8): just the
         # zipf-skewed cost-model-vs-blind series, in the same scrubbed

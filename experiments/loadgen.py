@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serving gateway (ISSUE 12).
+
+Poisson arrivals at a target rate (exponential inter-arrival gaps — the
+open-loop discipline: arrivals do NOT wait for earlier requests, so a
+saturated gateway sees real queue growth instead of the closed-loop
+self-throttling that hides it), per-request prompt/length sampling, and a
+JSON report:
+
+- ``tokens_per_sec`` served (completed streams' tokens over the wall),
+- ``ttft_p50_ms`` / ``ttft_p99_ms`` — submit-accepted → first token,
+- ``itl_p50_ms`` / ``itl_p99_ms`` — gaps between token receipts
+  (measured at poll granularity),
+- ``shed_fraction`` — sheds / arrivals (a shed is counted, not retried:
+  the report is about what THIS rate does to THIS gateway),
+- ``errors`` / ``crashes`` — stream-level error replies vs client-side
+  exceptions (the acceptance bar wants zero of the latter at any load).
+
+Importable (``run_load``) for bench.py / collect_gate.py, or a CLI::
+
+    python experiments/loadgen.py --endpoint 127.0.0.1:31400 \
+        --rate 20 --duration 10 --prompt-len 4 12 --max-new 8 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _pct(values, q) -> float:
+    return float(np.percentile(np.asarray(values), q)) if values else 0.0
+
+
+def run_load(
+    endpoint,
+    *,
+    rate_hz: float,
+    duration_s: float,
+    prompt_len: tuple = (4, 12),
+    max_new: tuple = (8, 16),
+    vocab: int = 258,
+    seed: int = 0,
+    poll_interval_s: float = 0.005,
+    drain_timeout_s: float = 120.0,
+) -> dict:
+    """Drive one gateway open-loop and return the JSON-ready report.
+
+    Every arrival runs on its own thread (submit + poll via
+    :class:`GatewayClient`; the RPC pool muxes them over shared
+    connections).  After the arrival window closes, in-flight streams are
+    drained up to ``drain_timeout_s`` so served-token counts are not
+    truncated mid-stream."""
+    from learning_at_home_tpu.gateway import GatewayClient
+
+    client = GatewayClient(endpoint)
+    rng = np.random.RandomState(seed)
+    lock = threading.Lock()
+    report = {
+        "arrivals": 0, "completed": 0, "shed": 0, "shed_with_retry_after": 0,
+        "errors": 0, "crashes": 0, "tokens_served": 0,
+    }
+    ttfts: list[float] = []
+    itls: list[float] = []
+    threads: list[threading.Thread] = []
+
+    def one_request(prompt, n_new) -> None:
+        token_times: list[float] = []
+        t_submit = time.monotonic()
+        try:
+            out = client.generate(
+                prompt, n_new,
+                poll_interval_s=poll_interval_s,
+                deadline_s=drain_timeout_s,
+                on_token=token_times.append,
+            )
+        except Exception:
+            with lock:
+                report["crashes"] += 1
+            return
+        with lock:
+            if out.get("shed"):
+                report["shed"] += 1
+                # a well-formed shed carries a positive retry-after —
+                # the overload acceptance bar checks this count == shed
+                ra = out.get("retry_after_s")
+                if isinstance(ra, (int, float)) and ra > 0:
+                    report["shed_with_retry_after"] += 1
+                return
+            if out.get("error"):
+                report["errors"] += 1
+                return
+            report["completed"] += 1
+            report["tokens_served"] += len(out["tokens"])
+            if token_times:
+                ttfts.append(token_times[0] - t_submit)
+                itls.extend(np.diff(token_times).tolist())
+
+    t0 = time.monotonic()
+    deadline = t0 + duration_s
+    next_arrival = t0
+    while next_arrival < deadline:
+        delay = next_arrival - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        p_len = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
+        n_new = int(rng.randint(max_new[0], max_new[1] + 1))
+        prompt = rng.randint(0, vocab, size=p_len).tolist()
+        th = threading.Thread(
+            target=one_request, args=(prompt, n_new), daemon=True
+        )
+        th.start()
+        threads.append(th)
+        report["arrivals"] += 1
+        next_arrival += float(rng.exponential(1.0 / rate_hz))
+    for th in threads:
+        th.join(timeout=drain_timeout_s)
+    wall = time.monotonic() - t0
+    with lock:
+        out = dict(report)
+    out.update(
+        rate_hz=rate_hz,
+        duration_s=duration_s,
+        wall_s=round(wall, 3),
+        tokens_per_sec=round(out["tokens_served"] / wall, 2) if wall else 0.0,
+        shed_fraction=round(
+            out["shed"] / out["arrivals"], 4
+        ) if out["arrivals"] else 0.0,
+        ttft_p50_ms=round(_pct(ttfts, 50) * 1e3, 1),
+        ttft_p99_ms=round(_pct(ttfts, 99) * 1e3, 1),
+        itl_p50_ms=round(_pct(itls, 50) * 1e3, 1),
+        itl_p99_ms=round(_pct(itls, 99) * 1e3, 1),
+    )
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--endpoint", required=True,
+                    help="gateway host:port (frontdoor RPC port)")
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="mean Poisson arrival rate, requests/s")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="arrival window, seconds (drain not included)")
+    ap.add_argument("--prompt-len", type=int, nargs=2, default=(4, 12),
+                    metavar=("MIN", "MAX"))
+    ap.add_argument("--max-new", type=int, nargs=2, default=(8, 16),
+                    metavar=("MIN", "MAX"))
+    ap.add_argument("--vocab", type=int, default=258)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    host, _, port = args.endpoint.rpartition(":")
+    if not port.isdigit():
+        raise SystemExit(f"--endpoint {args.endpoint!r} must be host:port")
+    report = run_load(
+        (host, int(port)),
+        rate_hz=args.rate,
+        duration_s=args.duration,
+        prompt_len=tuple(args.prompt_len),
+        max_new=tuple(args.max_new),
+        vocab=args.vocab,
+        seed=args.seed,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -389,6 +389,41 @@ class RemoteMixtureOfExperts:
         weights = jnp.where(mask, weights, 0.0)
         return jnp.einsum("bk,bkd->bd", weights.astype(y.dtype), y)
 
+    def preview_expert_sets(self, logits_concat) -> list:
+        """Per-row frozensets of the expert uids a dispatch of these gate
+        logits WOULD select — the gateway's coalescing key (gateway/
+        coalesce.py groups streams whose sets overlap so one pack-once
+        dispatch serves many of them).
+
+        Grid routing only (``routing="beam"`` resolves its alive set per
+        fire and has no cacheable preview).  The preview selects with
+        ``bias=None``: exact at routing cost weight 0 (bias is None on the
+        real dispatch too) and a grouping heuristic otherwise — grouping
+        never affects correctness because each group's dispatch reruns its
+        own biased selection over its own rows."""
+        if self.routing == "beam":
+            raise MoEDispatchError(
+                "preview_expert_sets requires grid routing (beam resolves "
+                "its alive set per dispatch)"
+            )
+        logits_concat = np.asarray(logits_concat)
+        logits = [
+            logits_concat[:, off : off + g]
+            for off, g in zip(self._grid_offsets, self.grid_size)
+        ]
+        alive = self.alive_cache.peek_fresh()
+        if alive is None:
+            alive = client_loop().run(self.alive_cache.get())
+        alive_uids = sorted(
+            filter_valid_uids(alive, self.uid_prefix, self.grid_size)
+        )
+        if not alive_uids:
+            raise MoEDispatchError(
+                f"no alive experts under prefix {self.uid_prefix!r}"
+            )
+        sel, _ = select_top_k(logits, alive_uids, self.k_best, bias=None)
+        return [frozenset(alive_uids[e] for e in row) for row in sel]
+
     # ---- fire/join: the overlapped two-phase form of __call__ ----
 
     def fire(self, x, gate_params: dict):

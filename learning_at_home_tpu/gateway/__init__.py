@@ -1,0 +1,20 @@
+"""Serving gateway: continuous batching + cross-user expert-set
+coalescing + admission control over the swarm dispatch path.
+
+See docs/PROTOCOL.md ("Gateway RPC family"), docs/CONCURRENCY.md (slot
+table ownership) and README.md (serving quick-start).
+"""
+
+from learning_at_home_tpu.gateway.admission import AdmissionController
+from learning_at_home_tpu.gateway.coalesce import ExpertCoalescer
+from learning_at_home_tpu.gateway.frontdoor import Gateway, GatewayClient
+from learning_at_home_tpu.gateway.scheduler import SlotScheduler, StreamState
+
+__all__ = [
+    "AdmissionController",
+    "ExpertCoalescer",
+    "Gateway",
+    "GatewayClient",
+    "SlotScheduler",
+    "StreamState",
+]

@@ -1,0 +1,140 @@
+"""Admission control: shed load BEFORE quality collapses.
+
+Two saturation signals, both cheap to read at admit time:
+
+- **gateway occupancy** — pending streams waiting for a slot.  Slots
+  full is normal (that is what continuous batching is for); an unbounded
+  pending queue is not: past ``max_pending`` every accepted stream only
+  inflates time-to-first-token, so the gateway sheds with a retry-after
+  instead (docs/PROTOCOL.md "Gateway RPC family").
+- **expert-server queue depth** — the swarm's own backpressure, read
+  from the ``load.<prefix>`` DHT heartbeats the servers already publish
+  (utils/telemetry.py, the same feed PR 8's routing cost model eats).
+  When the WORST advertised queue exceeds ``max_server_queue``, admitting
+  more decode work would pile onto servers that are already drowning.
+
+The DHT read is a blocking control-plane round trip, so it runs on this
+controller's own ``lah-gw-admission`` daemon thread on a fixed period;
+``admit()`` itself only reads cached floats and the scheduler's counters
+— safe to call from the front door's event loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class AdmissionController:
+    """Accept/shed decisions for one gateway."""
+
+    def __init__(
+        self,
+        scheduler,
+        *,
+        max_pending: Optional[int] = None,
+        max_server_queue: float = 64.0,
+        load_fn: Optional[Callable[[], dict]] = None,
+        refresh_period_s: float = 2.0,
+    ):
+        self.scheduler = scheduler
+        if max_pending is None:
+            try:
+                max_pending = int(
+                    os.environ.get(
+                        "LAH_GW_MAX_PENDING",
+                        str(4 * scheduler.decoder.max_slots),
+                    )
+                )
+            except ValueError:
+                max_pending = 4 * scheduler.decoder.max_slots
+        self.max_pending = max_pending
+        self.max_server_queue = float(max_server_queue)
+        self._load_fn = load_fn
+        self.refresh_period_s = refresh_period_s
+        self._server_queue_depth = 0.0  # worst advertised depth, cached
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.shed_total = 0
+        self.admitted_total = 0
+        self.load_refresh_failures = 0
+
+    # ---- background server-load watch ----
+
+    def start(self) -> "AdmissionController":
+        if self._load_fn is None or self._thread is not None:
+            return self
+
+        def watch() -> None:
+            while not self._stop.wait(self.refresh_period_s):
+                self._refresh_once()
+
+        self._refresh_once()
+        self._thread = threading.Thread(
+            target=watch, name="lah-gw-admission", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.refresh_period_s + 1)
+            self._thread = None
+
+    def _refresh_once(self) -> None:
+        try:
+            loads = self._load_fn() or {}
+            depths = [
+                float(rec.get("q", 0.0))
+                for rec in loads.values()
+                if isinstance(rec, dict)
+            ]
+            self._server_queue_depth = max(depths) if depths else 0.0
+        except Exception as e:
+            self.load_refresh_failures += 1
+            logger.warning("gateway server-load refresh failed: %s: %s",
+                           type(e).__name__, e)
+
+    @property
+    def server_queue_depth(self) -> float:
+        return self._server_queue_depth
+
+    # ---- the admit-time decision (event-loop safe: no I/O, no waits) ----
+
+    def admit(self) -> tuple[bool, Optional[float], Optional[str]]:
+        """(accepted, retry_after_s, reason).  retry_after_s/reason are
+        None on accept."""
+        pending = self.scheduler.pending_count()
+        if pending >= self.max_pending:
+            self.shed_total += 1
+            return (
+                False,
+                self.scheduler.estimate_retry_after_s(),
+                f"gateway saturated: {pending} pending >= "
+                f"max_pending {self.max_pending}",
+            )
+        if self._server_queue_depth > self.max_server_queue:
+            self.shed_total += 1
+            return (
+                False,
+                self.scheduler.estimate_retry_after_s(),
+                f"expert servers saturated: worst advertised queue depth "
+                f"{self._server_queue_depth:.0f} > {self.max_server_queue:.0f}",
+            )
+        self.admitted_total += 1
+        return True, None, None
+
+    def stats(self) -> dict:
+        return {
+            "max_pending": self.max_pending,
+            "max_server_queue": self.max_server_queue,
+            "server_queue_depth": self._server_queue_depth,
+            "shed_total": self.shed_total,
+            "admitted_total": self.admitted_total,
+            "load_refresh_failures": self.load_refresh_failures,
+        }

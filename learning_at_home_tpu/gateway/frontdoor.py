@@ -1,0 +1,423 @@
+"""The gateway front door: framed-TCP serving process for generate
+streams, plus the sync client that talks to it.
+
+Speaks the SAME wire protocol as expert servers (utils/serialization.py
+framing, ``hello`` → protocol v2 mux), so the existing
+``ConnectionPool``/``PoolRegistry`` client stack works against a gateway
+unchanged.  All gateway ops are meta-only control frames (token ids ride
+in msgpack meta, never as tensors — a generate stream moves a few ints
+per poll, not megabyte activations):
+
+- ``gen_submit`` {prompt: [int], max_new_tokens} →
+  {"accepted": true, "sid"} or
+  {"accepted": false, "shed": true, "retry_after_s", "message"}
+- ``gen_poll``   {sid, cursor} → {"tokens": [int], "cursor", "done",
+  "error"?} (tokens from ``cursor`` on; poll again from the returned
+  cursor — replies are immediate, never held)
+- ``gen_cancel`` {sid} → {"cancelled": bool}
+- ``stats``      {} → gateway counters + the metrics registry snapshot
+
+Invalid requests (unknown sid, malformed prompt, budget over capacity)
+get an ``error`` frame; a SHED is a well-formed ``result`` with
+``accepted=false`` — backpressure is an answer, not a failure
+(docs/PROTOCOL.md "Gateway RPC family").
+
+The serving loop (``lah-gateway`` BackgroundLoop) does admission reads,
+stream-table reads/writes (short ``gateway.streams`` lock sections) and
+framing only; prefill/decode compute and expert RPCs live on the
+scheduler's ``lah-gw-decode`` thread (docs/CONCURRENCY.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from learning_at_home_tpu.gateway.admission import AdmissionController
+from learning_at_home_tpu.gateway.coalesce import ExpertCoalescer
+from learning_at_home_tpu.gateway.scheduler import SlotScheduler
+from learning_at_home_tpu.models.swarm_decoder import SwarmKVDecoder
+from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
+from learning_at_home_tpu.utils.serialization import (
+    WireTensors,
+    pack_frames,
+    peek_header,
+    recv_frame,
+    send_frame_parts,
+    unpack_message,
+)
+
+logger = logging.getLogger(__name__)
+
+# same negotiation surface as the expert server: mux so thousands of
+# concurrent streams share connections; gateway frames are tiny control
+# meta, so the quantized-codec feature is not offered
+GATEWAY_FEATURES = ("mux",)
+
+
+class Gateway:
+    """Front-door serving process over one swarm model.
+
+    Owns the whole serving stack: decoder (static-shape KV slots),
+    coalescer (cross-user expert-set grouping), scheduler (continuous
+    batching on ``lah-gw-decode``), admission controller, the
+    ``lah-gateway`` serving loop, a metrics-registry collector, and —
+    when a DHT handle is passed — a ``telemetry.<prefix>`` heartbeat with
+    role ``gateway`` so ``lah_top`` renders it as a first-class peer.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int = 8,
+        coalesce: bool = True,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        dht=None,
+        telemetry_prefix: Optional[str] = None,
+        max_pending: Optional[int] = None,
+        max_server_queue: float = 64.0,
+        stream_ttl_s: Optional[float] = None,
+    ):
+        self.model = model
+        self.coalescer = ExpertCoalescer(coalesce=coalesce)
+        self.decoder = SwarmKVDecoder(
+            model, params, max_slots=max_slots,
+            moe_dispatch=self.coalescer.dispatch,
+        )
+        self.scheduler = SlotScheduler(
+            self.decoder, stream_ttl_s=stream_ttl_s
+        )
+        # server-load feed: the MoE's own cost model already TTL-caches
+        # the load.<prefix> heartbeats (PR 8) — reuse it instead of
+        # growing a second DHT reader.  loads() blocks on the refresh
+        # window, which is why admission polls it on its own thread.
+        load_fn = (
+            model.moes[0].cost_model.loads
+            if getattr(model, "moes", None) else None
+        )
+        self.admission = AdmissionController(
+            self.scheduler,
+            max_pending=max_pending,
+            max_server_queue=max_server_queue,
+            load_fn=load_fn,
+        )
+        self._loop = BackgroundLoop(name="lah-gateway")
+        self._server = None
+        self.host = host
+        try:
+            self.port: int = self._loop.run(self._start(host, port), timeout=10)
+        except BaseException:
+            self._loop.shutdown()
+            raise
+        self.endpoint = (host, self.port)
+        self.scheduler.start()
+        self.admission.start()
+        self.started_at = time.monotonic()
+        from learning_at_home_tpu.utils.metrics import registry
+
+        self._collector_key = f"gateway-{id(self)}"
+        registry.register_collector(self._collector_key, self._collect)
+        self.telemetry = None
+        if dht is not None:
+            from learning_at_home_tpu.utils.telemetry import (
+                TelemetryPublisher,
+            )
+
+            self.telemetry = TelemetryPublisher(
+                dht,
+                prefix=telemetry_prefix or model.cfg.telemetry_prefix,
+                role="gateway",
+                host=host,
+                meta={"gateway_port": self.port},
+                extra_fn=lambda: {"gateway": self.gateway_stats()},
+            ).start()
+
+    # ---- lifecycle ----
+
+    async def _start(self, host: str, port: int) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_connection, host, port
+        )
+        return self._server.sockets[0].getsockname()[1]
+
+    def shutdown(self) -> None:
+        from learning_at_home_tpu.utils.metrics import registry
+
+        registry.unregister_collector(self._collector_key)
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
+        self.admission.stop()
+        self.scheduler.shutdown()
+        if self._server is not None:
+            self._loop.loop.call_soon_threadsafe(self._server.close)
+            self._server = None
+        self._loop.shutdown()
+
+    def __enter__(self) -> "Gateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ---- observability ----
+
+    def gateway_stats(self) -> dict:
+        return {
+            **self.scheduler.stats(),
+            **self.admission.stats(),
+            **self.coalescer.stats(),
+            "uptime_s": time.monotonic() - self.started_at,
+        }
+
+    def _collect(self) -> dict:
+        s = self.scheduler
+        return {
+            "lah_gateway_streams_total": s.streams_total,
+            "lah_gateway_streams_finished_total": s.streams_finished_total,
+            "lah_gateway_streams_errored_total": s.streams_errored_total,
+            "lah_gateway_streams_cancelled_total": s.streams_cancelled_total,
+            "lah_gateway_streams_active": s.active_count(),
+            "lah_gateway_slots": self.decoder.max_slots,
+            "lah_gateway_slots_in_use": s.slots_in_use(),
+            "lah_gateway_tokens_total": s.tokens_total,
+            "lah_gateway_shed_total": self.admission.shed_total,
+            "lah_gateway_group_dispatches_total":
+                self.coalescer.group_dispatches_total,
+            "lah_gateway_coalesced_dispatches_total":
+                self.coalescer.coalesced_dispatches_total,
+            "lah_gateway_step_time_ema_s": s.step_time_ema or 0.0,
+        }
+
+    # ---- the serving loop (lah-gateway) ----
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        muxed = False
+        wlock = asyncio.Lock()
+        inflight: set[asyncio.Task] = set()
+        try:
+            while True:
+                try:
+                    payload = await recv_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                try:
+                    msg_type, rid = peek_header(payload)
+                except Exception:
+                    msg_type, rid = None, None
+                if msg_type == "hello":
+                    _, _, hmeta = unpack_message(payload)
+                    offered = hmeta.get("features") or []
+                    common = [f for f in GATEWAY_FEATURES if f in offered]
+                    muxed = "mux" in common
+                    await self._send(
+                        writer, wlock,
+                        pack_frames(
+                            "hello_ok", WireTensors.prepare(),
+                            {"features": common}, rid=rid,
+                        ),
+                    )
+                    continue
+                if muxed and rid is not None:
+                    task = asyncio.get_running_loop().create_task(
+                        self._serve_muxed(payload, rid, writer, wlock)
+                    )
+                    inflight.add(task)
+                    task.add_done_callback(inflight.discard)
+                    continue
+                await self._send(writer, wlock, self._dispatch(payload, rid))
+        except Exception:
+            logger.exception("gateway connection failed for peer %s", peer)
+        finally:
+            for task in inflight:
+                task.cancel()
+            writer.close()
+
+    @staticmethod
+    async def _send(writer, wlock: asyncio.Lock, parts: list) -> None:
+        async with wlock:
+            await send_frame_parts(writer, parts)
+
+    async def _serve_muxed(
+        self, payload: bytes, rid: int, writer, wlock: asyncio.Lock
+    ) -> None:
+        try:
+            await self._send(writer, wlock, self._dispatch(payload, rid))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("gateway muxed request %d failed", rid)
+
+    # sync, not async: every op below is dict/lock bookkeeping — the
+    # blocking compute lives on lah-gw-decode, never on this loop
+    def _dispatch(self, payload: bytes, rid=None) -> list:
+        def reply(msg_type: str, meta=None) -> list:
+            return pack_frames(
+                msg_type, WireTensors.prepare(), meta, rid=rid
+            )
+
+        try:
+            msg_type, _tensors, meta = unpack_message(payload)
+        except Exception as e:
+            return reply("error", {"message": f"malformed request: {e}"})
+        try:
+            if msg_type == "gen_submit":
+                return reply("result", self._gen_submit(meta))
+            elif msg_type == "gen_poll":
+                sid = meta.get("sid")
+                out = self.scheduler.poll(
+                    sid if isinstance(sid, str) else "",
+                    int(meta.get("cursor") or 0),
+                )
+                if out is None:
+                    return reply(
+                        "error", {"message": f"unknown stream {sid!r}"}
+                    )
+                if out["error"] is None:
+                    del out["error"]
+                return reply("result", out)
+            elif msg_type == "gen_cancel":
+                sid = meta.get("sid")
+                cancelled = self.scheduler.cancel(
+                    sid if isinstance(sid, str) else ""
+                )
+                return reply("result", {"cancelled": cancelled})
+            elif msg_type == "stats":
+                from learning_at_home_tpu.utils.metrics import registry
+
+                return reply(
+                    "result",
+                    {"gateway": self.gateway_stats(),
+                     "metrics": registry.snapshot()},
+                )
+            else:
+                return reply(
+                    "error",
+                    {"message": f"unknown message type {msg_type!r}"},
+                )
+        except Exception as e:
+            logger.exception("gateway request %s failed", msg_type)
+            return reply("error", {"message": f"{type(e).__name__}: {e}"})
+
+    def _gen_submit(self, meta: dict) -> dict:
+        prompt = meta.get("prompt")
+        max_new = meta.get("max_new_tokens")
+        vocab = self.model.cfg.vocab_size
+        if not (
+            isinstance(prompt, (list, tuple))
+            and prompt
+            and all(isinstance(t, int) and 0 <= t < vocab for t in prompt)
+        ):
+            raise ValueError(
+                "prompt must be a non-empty list of token ids in "
+                f"[0, {vocab})"
+            )
+        if not isinstance(max_new, int) or max_new < 1:
+            raise ValueError("max_new_tokens must be a positive int")
+        capacity = self.decoder.seq_len - len(prompt)
+        if capacity < 1:
+            raise ValueError(
+                f"prompt length {len(prompt)} leaves no decode capacity "
+                f"(cache holds {self.decoder.seq_len} positions)"
+            )
+        accepted, retry_after_s, reason = self.admission.admit()
+        if not accepted:
+            return {
+                "accepted": False,
+                "shed": True,
+                "retry_after_s": retry_after_s,
+                "message": reason,
+            }
+        sid = self.scheduler.submit(
+            prompt, min(max_new, capacity)
+        )
+        return {"accepted": True, "sid": sid}
+
+
+class GatewayClient:
+    """Sync client over the shared RPC stack (control-plane ``rpc()`` on
+    the ``lah-client`` loop — gateway frames are tiny meta maps)."""
+
+    def __init__(self, endpoint, timeout: float = 30.0):
+        self.endpoint = (endpoint[0], int(endpoint[1]))
+        self.timeout = timeout
+
+    def _rpc(self, msg_type: str, meta: dict) -> dict:
+        from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+
+        pool = pool_registry().get(self.endpoint)
+        _tensors, reply = client_loop().run(
+            pool.rpc(msg_type, meta=meta, timeout=self.timeout),
+            timeout=self.timeout + 5,
+        )
+        return reply or {}
+
+    def submit(self, prompt, max_new_tokens: int) -> dict:
+        """One admission attempt; the reply is either accepted ({sid}) or
+        a shed ({shed, retry_after_s}).  Raises RemoteCallError only for
+        INVALID requests — backpressure is a normal reply."""
+        return self._rpc(
+            "gen_submit",
+            {"prompt": [int(t) for t in prompt],
+             "max_new_tokens": int(max_new_tokens)},
+        )
+
+    def poll(self, sid: str, cursor: int = 0) -> dict:
+        return self._rpc("gen_poll", {"sid": sid, "cursor": int(cursor)})
+
+    def cancel(self, sid: str) -> bool:
+        return bool(self._rpc("gen_cancel", {"sid": sid}).get("cancelled"))
+
+    def stats(self) -> dict:
+        return self._rpc("stats", {})
+
+    def generate(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        poll_interval_s: float = 0.005,
+        deadline_s: float = 120.0,
+        on_token=None,
+    ) -> dict:
+        """Submit once and poll to completion.  Returns
+        ``{"tokens", "shed", "retry_after_s"?, "error"?}`` — a shed
+        returns immediately (open-loop callers own the retry policy)."""
+        sub = self.submit(prompt, max_new_tokens)
+        if not sub.get("accepted"):
+            return {
+                "tokens": [],
+                "shed": True,
+                "retry_after_s": sub.get("retry_after_s"),
+            }
+        sid = sub["sid"]
+        tokens: list[int] = []
+        cursor = 0
+        deadline = time.monotonic() + deadline_s
+        while True:
+            out = self.poll(sid, cursor)
+            fresh = out.get("tokens") or []
+            if fresh:
+                tokens.extend(int(t) for t in fresh)
+                cursor = int(out.get("cursor") or cursor + len(fresh))
+                if on_token is not None:
+                    for _ in fresh:
+                        on_token(time.monotonic())
+            if out.get("done"):
+                result = {"tokens": tokens, "shed": False}
+                if out.get("error") is not None:
+                    result["error"] = out["error"]
+                return result
+            if time.monotonic() > deadline:
+                self.cancel(sid)
+                return {"tokens": tokens, "shed": False,
+                        "error": "client deadline exceeded"}
+            time.sleep(poll_interval_s)

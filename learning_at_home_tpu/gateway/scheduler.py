@@ -1,0 +1,356 @@
+"""Continuous-batching slot scheduler: the gateway's decode engine.
+
+One dedicated daemon thread (``lah-gw-decode``) EXCLUSIVELY owns the
+:class:`SwarmKVDecoder` — its slot table, KV caches and per-slot scalars
+are never touched from any other thread or loop (docs/CONCURRENCY.md).
+The loop it runs is the whole continuous-batching policy:
+
+1. evict streams cancelled since the last pass (slot + KV rows freed);
+2. admit pending streams into free slots (one prefill each — prefill is
+   serial, decode is batched, the standard continuous-batching split);
+3. one :meth:`decode_step` advances EVERY live stream by one token —
+   arrivals join at token boundaries, nothing waits for a batch drain;
+4. streams that hit their token budget or cache capacity vacate their
+   slot immediately.
+
+Everything the FRONT DOOR touches (the stream table, the pending queue,
+per-stream token buffers) is guarded by the ``gateway.streams`` lock with
+short critical sections; the decoder itself needs no lock because only
+this thread calls it.  Stream results for clients that never poll again
+are garbage-collected after ``LAH_GW_STREAM_TTL_S`` (default 600 s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional
+
+from learning_at_home_tpu.utils import sanitizer
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_STREAM_TTL_S = 600.0
+
+
+@dataclasses.dataclass
+class StreamState:
+    sid: str
+    prompt: list
+    max_new_tokens: int
+    tokens: list = dataclasses.field(default_factory=list)
+    done: bool = False
+    error: Optional[str] = None
+    cancelled: bool = False
+    slot: Optional[int] = None
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+
+class SlotScheduler:
+    """Stream table + the ``lah-gw-decode`` thread driving the decoder."""
+
+    def __init__(
+        self,
+        decoder,
+        *,
+        idle_wait_s: float = 0.02,
+        stream_ttl_s: Optional[float] = None,
+    ):
+        self.decoder = decoder
+        self.idle_wait_s = idle_wait_s
+        if stream_ttl_s is None:
+            try:
+                stream_ttl_s = float(
+                    os.environ.get("LAH_GW_STREAM_TTL_S",
+                                   str(_DEFAULT_STREAM_TTL_S))
+                )
+            except ValueError:
+                stream_ttl_s = _DEFAULT_STREAM_TTL_S
+        self.stream_ttl_s = stream_ttl_s
+        self._lock = sanitizer.lock("gateway.streams")
+        self._streams: dict[str, StreamState] = {}
+        self._pending: deque[str] = deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sid_counter = itertools.count()
+        self._sid_salt = uuid.uuid4().hex[:6]
+        # counters (read by metrics collector / stats; guarded by _lock)
+        self.streams_total = 0
+        self.streams_finished_total = 0
+        self.streams_errored_total = 0
+        self.streams_cancelled_total = 0
+        self.tokens_total = 0
+        # decode-step wall time EMA (seconds) — the admission controller's
+        # retry-after scale
+        self.step_time_ema: Optional[float] = None
+        self._last_gc = time.monotonic()
+
+    # ---- lifecycle ----
+
+    def start(self) -> "SlotScheduler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="lah-gw-decode", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # ---- front-door surface (any thread/loop; short lock sections) ----
+
+    def submit(self, prompt, max_new_tokens: int) -> str:
+        """Enqueue a stream; returns its sid.  Admission (shed/accept) is
+        the caller's job — this never refuses."""
+        sid = f"s{next(self._sid_counter)}-{self._sid_salt}"
+        st = StreamState(
+            sid=sid, prompt=list(prompt), max_new_tokens=int(max_new_tokens)
+        )
+        with self._lock:
+            self._streams[sid] = st
+            self._pending.append(sid)
+            self.streams_total += 1
+        self._wake.set()
+        return sid
+
+    def poll(self, sid: str, cursor: int = 0) -> Optional[dict]:
+        """Tokens from ``cursor`` on, plus done/error; None = unknown sid."""
+        with self._lock:
+            st = self._streams.get(sid)
+            if st is None:
+                return None
+            cursor = max(0, int(cursor))
+            return {
+                "sid": sid,
+                "tokens": list(st.tokens[cursor:]),
+                "cursor": cursor + len(st.tokens[cursor:]),
+                "done": st.done,
+                "error": st.error,
+            }
+
+    def cancel(self, sid: str) -> bool:
+        with self._lock:
+            st = self._streams.get(sid)
+            if st is None:
+                return False
+            already_done = st.done
+            st.cancelled = True
+        self._wake.set()
+        return not already_done
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def active_count(self) -> int:
+        """Streams holding a slot or waiting for one."""
+        with self._lock:
+            return sum(
+                1 for st in self._streams.values() if not st.done
+            )
+
+    def slots_in_use(self) -> int:
+        # reading the decoder's live mask from another thread is a benign
+        # monitoring race (numpy bool reads tear at element granularity)
+        return int(self.decoder.live.sum())
+
+    def estimate_retry_after_s(self) -> float:
+        """Best-effort hint for shed replies: how long until a slot is
+        plausibly free — queued work × observed per-step time over the
+        slot count, clamped to [0.1, 30]."""
+        step = self.step_time_ema or 0.05
+        with self._lock:
+            backlog = len(self._pending) + 1
+            budgets = [
+                max(1, st.max_new_tokens - len(st.tokens))
+                for st in self._streams.values()
+                if not st.done
+            ]
+        mean_budget = (sum(budgets) / len(budgets)) if budgets else 8.0
+        est = backlog * mean_budget * step / max(1, self.decoder.max_slots)
+        return float(min(30.0, max(0.1, est)))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "streams_total": self.streams_total,
+                "streams_finished_total": self.streams_finished_total,
+                "streams_errored_total": self.streams_errored_total,
+                "streams_cancelled_total": self.streams_cancelled_total,
+                "tokens_total": self.tokens_total,
+                "streams_active": sum(
+                    1 for st in self._streams.values() if not st.done
+                ),
+                "pending": len(self._pending),
+                "slots": self.decoder.max_slots,
+                "slots_in_use": self.slots_in_use(),
+                "step_time_ema_s": self.step_time_ema,
+            }
+
+    # ---- the decode loop (lah-gw-decode thread ONLY below here) ----
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self._iteration()
+            except Exception:
+                # the loop must survive anything a single pass throws —
+                # a dead decode thread strands every live stream
+                logger.exception("gateway decode iteration failed")
+                worked = False
+            if not worked:
+                self._wake.wait(timeout=self.idle_wait_s)
+                self._wake.clear()
+
+    def _iteration(self) -> bool:
+        now = time.monotonic()
+        self._evict_cancelled(now)
+        self._admit_pending(now)
+        worked = self._decode_once(now)
+        if now - self._last_gc > max(1.0, self.stream_ttl_s / 10):
+            self._gc_streams(now)
+            self._last_gc = now
+        return worked
+
+    def _finish(self, st: StreamState, now: float, *, error=None,
+                cancelled=False) -> None:
+        """Release st's slot (decoder side) and mark it done (table side).
+        Caller must NOT hold the lock.  Idempotent: a stream cancelled
+        while pending is finished by ``_evict_cancelled`` but its sid is
+        still in the pending deque, so ``_admit_pending`` reaches it a
+        second time — the counters must not double-count it."""
+        if st.slot is not None:
+            self.decoder.evict(st.slot)
+        with self._lock:
+            if st.done:
+                st.slot = None
+                return
+            st.slot = None
+            st.done = True
+            st.finished_at = now
+            if error is not None:
+                st.error = error
+                self.streams_errored_total += 1
+            elif cancelled:
+                self.streams_cancelled_total += 1
+            else:
+                self.streams_finished_total += 1
+
+    def _evict_cancelled(self, now: float) -> None:
+        with self._lock:
+            doomed = [
+                st for st in self._streams.values()
+                if st.cancelled and not st.done
+            ]
+        for st in doomed:
+            self._finish(st, now, cancelled=True)
+
+    def _admit_pending(self, now: float) -> None:
+        while True:
+            free = self.decoder.free_slots()
+            if not free:
+                return
+            with self._lock:
+                sid = self._pending.popleft() if self._pending else None
+                st = self._streams.get(sid) if sid else None
+            if st is None:
+                return
+            if st.cancelled:
+                self._finish(st, now, cancelled=True)
+                continue
+            try:
+                tok = self.decoder.prefill_into_slot(
+                    free[0], st.prompt, stream_id=st.sid
+                )
+            except Exception as e:
+                logger.exception("prefill failed for stream %s", st.sid)
+                self._finish(st, now, error=f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                st.slot = free[0]
+                st.first_token_at = time.monotonic()
+                st.tokens.append(tok)
+                self.tokens_total += 1
+                full = (
+                    len(st.tokens) >= st.max_new_tokens
+                    or self.decoder.at_capacity(free[0])
+                )
+            if full:
+                self._finish(st, now)
+
+    def _decode_once(self, now: float) -> bool:
+        live = self.decoder.live_slots()
+        if not live:
+            return False
+        t0 = time.monotonic()
+        try:
+            nxt = self.decoder.decode_step()
+        except Exception as e:
+            # a failed step (e.g. total dispatch failure with every
+            # expert down) poisons every stream in the batch: error them
+            # all out rather than spin on the same failure
+            logger.exception("decode step failed — erroring %d streams",
+                             len(live))
+            for _slot, sid in live:
+                with self._lock:
+                    st = self._streams.get(sid)
+                if st is not None:
+                    self._finish(st, now, error=f"{type(e).__name__}: {e}")
+            return True
+        dt = time.monotonic() - t0
+        self.step_time_ema = (
+            dt if self.step_time_ema is None
+            else 0.8 * self.step_time_ema + 0.2 * dt
+        )
+        finished = []
+        with self._lock:
+            for slot, sid in live:
+                st = self._streams.get(sid)
+                if st is None:  # GC'd mid-flight: free the slot below
+                    finished.append((slot, None))
+                    continue
+                st.tokens.append(int(nxt[slot]))
+                self.tokens_total += 1
+                if (
+                    len(st.tokens) >= st.max_new_tokens
+                    or self.decoder.at_capacity(slot)
+                    or st.cancelled
+                ):
+                    finished.append((slot, st))
+        for slot, st in finished:
+            if st is None:
+                self.decoder.evict(slot)
+            else:
+                self._finish(st, now, cancelled=st.cancelled)
+        return True
+
+    def _gc_streams(self, now: float) -> None:
+        """Drop finished streams nobody polled away after the TTL —
+        bounded memory under fire-and-forget clients."""
+        with self._lock:
+            stale = [
+                sid for sid, st in self._streams.items()
+                if st.done and st.finished_at is not None
+                and now - st.finished_at > self.stream_ttl_s
+            ]
+            for sid in stale:
+                del self._streams[sid]
+        if stale:
+            logger.info("gateway stream GC dropped %d stale results",
+                        len(stale))

@@ -1,0 +1,267 @@
+"""Incremental (KV-cache) decoding for the SWARM model — the decode core
+shared by the serving gateway (gateway/scheduler.py) and the
+``generate_lm.py --swarm`` probe.
+
+Pod mode decodes inside one jitted scan (models/transformer.py
+``_generate_cached``) because its MoE is a local sharded matmul.  Swarm
+mode cannot: every FFN layer is a network fan-out
+(``RemoteMixtureOfExperts.dispatch_async``), so the decode step runs
+EAGERLY on the host — trunk math in jnp, MoE via the pack-once dispatch —
+and the caches live at **static shapes** ``[max_slots, S, H, hd]`` so
+streams can join and leave a running batch (continuous batching) without
+ever recompiling or reallocating:
+
+- :meth:`prefill_into_slot` runs the full prompt forward for ONE stream
+  and writes its K/V rows into a free slot;
+- :meth:`decode_step` advances EVERY live slot by one token in one
+  [max_slots]-row trunk pass — per-slot positions ride through
+  :func:`~learning_at_home_tpu.models.trunk.one_query_attention` as a
+  ``[B,1,1,1]`` mask bound, so streams at different depths share the
+  batch; dead rows compute garbage that is never read (their slots are
+  re-prefilled before reuse) and are excluded from the MoE fan-out;
+- :meth:`evict` frees a slot immediately (no batch-drain barrier).
+
+The MoE fan-out goes through a pluggable ``moe_dispatch`` hook: the
+default fires one pack-once dispatch per call; the gateway injects
+``ExpertCoalescer.dispatch`` (gateway/coalesce.py) which groups rows of
+streams with overlapping expert sets into shared dispatches.  The hook
+only ever receives LIVE rows, so correctness never depends on it.
+
+Ownership: a decoder instance is single-threaded by contract — the
+gateway's ``lah-gw-decode`` thread owns it exclusively
+(docs/CONCURRENCY.md); tests and generate_lm drive it from one thread.
+
+Greedy decoding only (temperature 0): serving determinism is what the
+coalescing bitwise tests and the A/B gate on.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from learning_at_home_tpu.models.trunk import (
+    attention_core,
+    layer_norm,
+    one_query_attention,
+    output_projection,
+    qkv_projections,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def default_moe_dispatch(layer, moe, gate_params, x_rows, row_streams):
+    """One pack-once dispatch for all rows of one decode/prefill call —
+    gate in jnp (differentiability is irrelevant here, but the math must
+    match training's :meth:`RemoteMixtureOfExperts.__call__` exactly),
+    fire, join, combine.  ``row_streams`` is unused: this is the
+    ungrouped baseline the coalescer is benched and tested against."""
+    x_rows = jnp.asarray(x_rows)
+    logits_concat = jnp.concatenate(
+        [x_rows @ gate_params[f"w{d}"] for d in range(moe.n_dims)], axis=-1
+    )
+    fut = moe.dispatch_async(
+        np.asarray(x_rows), np.asarray(logits_concat), store_session=False
+    )
+    y, idx, mask, _cid = fut.join()
+    return moe._combine(y, idx, mask, logits_concat)
+
+
+class SwarmKVDecoder:
+    """Slot-table KV-cache decoder over a ``SwarmDMoETransformerLM``.
+
+    ``max_slots`` concurrent streams, each up to ``seq_len`` total
+    positions (prompt + generated).  All arrays are allocated once at
+    construction; stream churn mutates per-slot scalars and overwrites
+    cache rows in place.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int = 8,
+        max_seq_len: Optional[int] = None,
+        moe_dispatch: Optional[Callable] = None,
+    ):
+        cfg = model.cfg
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.model = model
+        self.params = params
+        self.max_slots = int(max_slots)
+        self.seq_len = int(max_seq_len or cfg.seq_len)
+        if self.seq_len > cfg.seq_len:
+            raise ValueError(
+                f"max_seq_len {self.seq_len} exceeds the model's position "
+                f"table ({cfg.seq_len})"
+            )
+        hd = cfg.d_model // cfg.n_heads
+        shape = (self.max_slots, self.seq_len, cfg.n_heads, hd)
+        self.k_caches = [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)]
+        self.v_caches = [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)]
+        # per-slot scalars (host side — only the owning thread touches them)
+        self.pos = np.zeros(self.max_slots, np.int32)  # cached positions == t
+        self.last_tok = np.zeros(self.max_slots, np.int32)
+        self.live = np.zeros(self.max_slots, bool)
+        self.stream_ids: list = [None] * self.max_slots
+        self._moe_dispatch = moe_dispatch or default_moe_dispatch
+        self.prefills_total = 0
+        self.decode_steps_total = 0
+
+    # ---- slot bookkeeping ----
+
+    def free_slots(self) -> list[int]:
+        return [i for i in range(self.max_slots) if not self.live[i]]
+
+    def live_slots(self) -> list[tuple[int, object]]:
+        """(slot, stream_id) for every occupied slot, slot order."""
+        return [
+            (i, self.stream_ids[i])
+            for i in range(self.max_slots)
+            if self.live[i]
+        ]
+
+    def at_capacity(self, slot: int) -> bool:
+        """True when the slot has no cache row left for another token."""
+        return int(self.pos[slot]) >= self.seq_len
+
+    def evict(self, slot: int) -> None:
+        """Free a slot immediately.  Cache rows are NOT zeroed: the next
+        prefill overwrites positions [0, p) and every decode step's
+        attention masks positions > t, so stale rows are unreachable."""
+        self.live[slot] = False
+        self.stream_ids[slot] = None
+
+    # ---- prefill: one stream's prompt forward into a free slot ----
+
+    def prefill_into_slot(self, slot: int, prompt_ids, stream_id=None) -> int:
+        """Full forward over one prompt; K/V written into ``slot``;
+        returns the first greedy token.  The trunk math is exactly
+        ``SwarmDMoETransformerLM.apply`` (trunk.py helpers), so a decoder
+        parity test against a re-forward holds to numerical noise."""
+        if self.live[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        prompt = np.asarray(prompt_ids, np.int32)
+        p = int(prompt.shape[0])
+        if not 0 < p < self.seq_len:
+            raise ValueError(
+                f"prompt length {p} must be in [1, {self.seq_len - 1}] "
+                "(one free position is needed to decode)"
+            )
+        cfg = self.model.cfg
+        params = self.params
+        x = params["embed"][jnp.asarray(prompt)][None] + params["pos"][None, :p]
+        for i, lp in enumerate(params["layers"]):
+            h = layer_norm(lp["ln1"], x)
+            q, k, v = qkv_projections(lp, h, cfg.n_heads)
+            x = x + output_projection(lp, attention_core(q, k, v))
+            self.k_caches[i] = self.k_caches[i].at[slot, :p].set(k[0])
+            self.v_caches[i] = self.v_caches[i].at[slot, :p].set(v[0])
+            moe_in = layer_norm(lp["ln2"], x).reshape(p, cfg.d_model)
+            y = self._moe_dispatch(
+                i, self.model.moes[i], lp["gate"], moe_in, [stream_id] * p
+            )
+            x = x + jnp.asarray(y).reshape(1, p, cfg.d_model).astype(x.dtype)
+        x_last = layer_norm(params["ln_f"], x[:, -1])
+        logits = x_last @ params["embed"].T
+        tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        self.pos[slot] = p
+        self.last_tok[slot] = tok
+        self.live[slot] = True
+        self.stream_ids[slot] = stream_id
+        self.prefills_total += 1
+        return tok
+
+    # ---- decode: one token for every live slot in one batch ----
+
+    def decode_step(self) -> np.ndarray:
+        """Advance every live slot by one token.  Returns the [max_slots]
+        int32 next-token array — entries at dead slots are garbage.  The
+        trunk runs at the static [max_slots] batch (dead rows compute on
+        position-0 garbage, never read); the MoE fan-out sees only the
+        live rows."""
+        live_rows = np.nonzero(self.live)[0]
+        if live_rows.size == 0:
+            return np.zeros(self.max_slots, np.int32)
+        if any(self.at_capacity(int(s)) for s in live_rows):
+            raise ValueError("a live slot is at capacity — evict it first")
+        cfg = self.model.cfg
+        params = self.params
+        b = self.max_slots
+        t = np.where(self.live, self.pos, 0).astype(np.int32)
+        t_j = jnp.asarray(t)
+        rows_idx = jnp.arange(b)
+        x = params["embed"][jnp.asarray(self.last_tok)] + params["pos"][t_j]
+        x = x[:, None, :]  # [B, 1, d]
+        live_j = jnp.asarray(live_rows)
+        for i, lp in enumerate(params["layers"]):
+            h = layer_norm(lp["ln1"], x)
+            q, k, v = qkv_projections(lp, h, cfg.n_heads)
+            self.k_caches[i] = self.k_caches[i].at[rows_idx, t_j].set(k[:, 0])
+            self.v_caches[i] = self.v_caches[i].at[rows_idx, t_j].set(v[:, 0])
+            x = x + one_query_attention(
+                lp, q, self.k_caches[i], self.v_caches[i],
+                t_j[:, None, None, None],
+            )
+            moe_in = layer_norm(lp["ln2"], x).reshape(b, cfg.d_model)
+            y_rows = self._moe_dispatch(
+                i, self.model.moes[i], lp["gate"], moe_in[live_j],
+                [self.stream_ids[int(r)] for r in live_rows],
+            )
+            moe_out = (
+                jnp.zeros((b, cfg.d_model), x.dtype)
+                .at[live_j].set(jnp.asarray(y_rows).astype(x.dtype))
+            )
+            x = x + moe_out[:, None, :]
+        x = layer_norm(params["ln_f"], x)
+        logits = x[:, 0] @ params["embed"].T
+        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        self.last_tok[self.live] = nxt[self.live]
+        self.pos[self.live] += 1
+        self.decode_steps_total += 1
+        return nxt
+
+    # ---- convenience: closed-loop batch generation ----
+
+    def generate(
+        self, prompts: Sequence[Sequence[int]], max_new_tokens: int
+    ) -> list[list[int]]:
+        """Decode a fixed batch of prompts to completion (no mid-flight
+        joins) — the ``generate_lm.py --swarm`` path and the parity
+        tests.  Requires an empty decoder with ``len(prompts) <=
+        max_slots``."""
+        if len(prompts) > len(self.free_slots()):
+            raise ValueError(
+                f"{len(prompts)} prompts need {len(prompts)} free slots, "
+                f"have {len(self.free_slots())}"
+            )
+        slots = []
+        outs: list[list[int]] = []
+        for sid, prompt in enumerate(prompts):
+            slot = self.free_slots()[0]
+            tok = self.prefill_into_slot(slot, prompt, stream_id=sid)
+            slots.append(slot)
+            outs.append([tok])
+        for _ in range(max_new_tokens - 1):
+            active = [s for s in slots if self.live[s]]
+            if not active:
+                break
+            nxt = self.decode_step()
+            for sid, slot in enumerate(slots):
+                if self.live[slot]:
+                    outs[sid].append(int(nxt[slot]))
+                    if (
+                        len(outs[sid]) >= max_new_tokens
+                        or self.at_capacity(slot)
+                    ):
+                        self.evict(slot)
+        for slot in slots:
+            if self.live[slot]:
+                self.evict(slot)
+        return outs

@@ -30,6 +30,7 @@ from learning_at_home_tpu.models.trunk import (
     attention_core,
     causal_attention,
     layer_norm,
+    one_query_attention,
     output_projection,
     qkv_projections,
 )
@@ -595,22 +596,11 @@ class DMoETransformerLM:
     def _one_query_attention(
         lp, q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, t: jax.Array
     ) -> jax.Array:
-        """Attention for ONE query position over the cache.
-
-        q [B,1,H,hd]; caches [B,S,H,hd] (positions > t are garbage and
-        masked).  f32 softmax, 1/sqrt(hd) scale — the same numerics as
-        ``jax.nn.dot_product_attention`` in the full forward.
-        """
-        hd = q.shape[-1]
-        scores = jnp.einsum(
-            "bqhd,bshd->bhqs", q, k_cache, preferred_element_type=jnp.float32
-        ) * (1.0 / np.sqrt(hd))
-        s = k_cache.shape[1]
-        mask = jnp.arange(s, dtype=jnp.int32)[None, None, None, :] <= t
-        scores = jnp.where(mask, scores, -jnp.inf)
-        w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhqs,bshd->bqhd", w, v_cache)
-        return output_projection(lp, out)
+        """Attention for ONE query position over the cache — the shared
+        :func:`~learning_at_home_tpu.models.trunk.one_query_attention`
+        (the swarm KV decoder uses the same function with per-row ``t``,
+        so pod and gateway decode steps cannot drift numerically)."""
+        return one_query_attention(lp, q, k_cache, v_cache, t)
 
     def _generate_cached(
         self,

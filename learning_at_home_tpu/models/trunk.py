@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def layer_norm(p: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
@@ -83,3 +84,31 @@ def attention_core(
             sm_scale=1.0 / (hd ** 0.5),
         ).transpose(0, 2, 1, 3)
     return jax.nn.dot_product_attention(q, k, v, is_causal=True)
+
+
+def one_query_attention(
+    lp: dict, q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, t
+) -> jax.Array:
+    """Attention for ONE query position per row over a KV cache.
+
+    q [B,1,H,hd]; caches [B,S,H,hd] (positions > t are garbage and
+    masked).  f32 softmax, 1/sqrt(hd) scale — the same numerics as
+    ``jax.nn.dot_product_attention`` in the full forward.
+
+    ``t`` is either a scalar (pod decode: every row sits at the same
+    position) or anything broadcastable against the [B,1,1,S] score mask
+    — the swarm KV decoder (models/swarm_decoder.py) passes [B,1,1,1]
+    per-slot positions so one continuous batch can hold streams at
+    different depths.  Shared here so the pod decoder and the gateway's
+    swarm decoder cannot drift numerically.
+    """
+    hd = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bshd->bhqs", q, k_cache, preferred_element_type=jnp.float32
+    ) * (1.0 / np.sqrt(hd))
+    s = k_cache.shape[1]
+    mask = jnp.arange(s, dtype=jnp.int32)[None, None, None, :] <= t
+    scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqs,bshd->bqhd", w, v_cache)
+    return output_projection(lp, out)

@@ -171,7 +171,10 @@ def smoke_worker() -> int:
     rc = dht_smoke()
     if rc:
         return rc
-    return slo_smoke()
+    rc = slo_smoke()
+    if rc:
+        return rc
+    return gateway_smoke()
 
 
 def dht_smoke() -> int:
@@ -728,6 +731,78 @@ def telemetry_smoke() -> int:
     return 0
 
 
+def gateway_smoke() -> int:
+    """Gateway gate (ISSUE 12): two subprocess expert servers + one
+    in-process serving gateway, ~8 concurrent streams driven open-loop
+    by experiments/loadgen.py.  Every accepted stream must finish (zero
+    sheds, zero errors, zero client crashes at this far-below-saturation
+    rate) and the coalescer must have grouped overlapping expert sets:
+    the number of pack-once dispatches actually fired must be STRICTLY
+    less than the per-stream dispatch count an ungrouped gateway would
+    have issued (fired + coalesced-away)."""
+    import jax
+
+    from experiments.loadgen import run_load
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.gateway import Gateway
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmDMoETransformerLM,
+        SwarmTransformerConfig,
+    )
+    from learning_at_home_tpu.utils.subproc import (
+        shutdown_procs,
+        spawn_expert_servers,
+    )
+
+    try:
+        procs, ports = spawn_expert_servers(
+            REPO, "gws", (0.0, 0.0), d_model=16, num_experts=2
+        )
+    except Exception as e:
+        print(f"collect_gate: gateway smoke setup failed: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        source = StaticExpertSource({
+            f"gws{layer}.{e}": ("127.0.0.1", ports[layer])
+            for layer in range(2) for e in range(2)
+        })
+        cfg = SwarmTransformerConfig(
+            vocab_size=64, d_model=16, n_layers=2, n_heads=4, seq_len=32,
+            grid_size=(2,), k_best=2, k_min=2, uid_prefix="gws",
+            timeout_after_k_min=30.0, forward_timeout=60.0,
+            backward_timeout=60.0, wire_codec="none",
+            routing_cost_weight=0,
+        )
+        model = SwarmDMoETransformerLM(cfg, source)
+        params = model.init_params(jax.random.PRNGKey(0))
+        with Gateway(model, params, max_slots=8, coalesce=True) as gw:
+            rep = run_load(
+                gw.endpoint, rate_hz=40.0, duration_s=0.2,
+                prompt_len=(6, 6), max_new=(8, 8), vocab=64, seed=0,
+            )
+            co = gw.coalescer.stats()
+        assert rep["arrivals"] >= 4, f"loadgen produced too few: {rep}"
+        assert rep["completed"] == rep["arrivals"], f"dropped streams: {rep}"
+        assert rep["shed"] == rep["errors"] == rep["crashes"] == 0, rep
+        fired = co["group_dispatches_total"]
+        per_stream = fired + co["coalesced_dispatches_total"]
+        assert fired < per_stream, (
+            f"coalescer never grouped: fired {fired} == per-stream "
+            f"{per_stream}"
+        )
+        print(
+            f"gateway: {rep['completed']} streams, {rep['tokens_served']} "
+            f"tokens, dispatches fired {fired} vs per-stream {per_stream}"
+        )
+    finally:
+        shutdown_procs(procs)
+        reset_client_rpc()
+    print("GATEWAY_SMOKE_OK coalesce=expert-set")
+    return 0
+
+
 def run_smoke() -> int:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -735,9 +810,10 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # nine smokes now (client path, averaging, codec, telemetry+
+            # ten smokes now (client path, averaging, codec, telemetry+
             # lah_top subprocess, replication, overlap, lifecycle, DHT
-            # swarm sim, SLO churn harness): a wider bound than the gate's
+            # swarm sim, SLO churn harness, serving gateway): a wider
+            # bound than the gate's
             timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "1200")),
         )
     except subprocess.TimeoutExpired:
@@ -754,6 +830,7 @@ def run_smoke() -> int:
         or "LIFECYCLE_SMOKE_OK" not in r.stdout
         or "DHT_SMOKE_OK" not in r.stdout
         or "SLO_SMOKE_OK" not in r.stdout
+        or "GATEWAY_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
               file=sys.stderr)
