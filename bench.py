@@ -233,7 +233,7 @@ def run_dht_sim_bench(deadline: int = 420, sizes: str = "128,512") -> dict | Non
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "ab9aead"
+PREV_ROUND_REV = "e7022b4"
 
 
 def check_orphan_servers() -> dict | None:
@@ -1695,14 +1695,188 @@ def gateway_worker() -> None:
             and out["gateway_cb_over_shed_with_retry_after"]
             == out["gateway_cb_over_shed"]
         )
+        print(json.dumps(out), flush=True)
+
+        # ---- ISSUE 13 arm: paged pool serves MORE concurrency per page
+        # budget.  Dense sizing reserves seq_len tokens per slot; pages
+        # bound capacity by tokens IN FLIGHT.  Same 32-page budget: the
+        # dense arm fits 4 slots (4 x seq 32 / page_len 4), the paged arm
+        # offers 16 and lets admission/preemption police the pool.  Peak
+        # concurrent streams (sampled slots_in_use) must be strictly
+        # higher on the paged arm at the same 2x-overload offered rate.
+        import threading as _threading
+
+        def _peak_streams(gw_kwargs, rate, seed):
+            with Gateway(
+                model, params, coalesce=True, max_pending=64, **gw_kwargs
+            ) as gw:
+                GatewayClient(gw.endpoint).generate(
+                    list(range(1, prompt_len + 1)), 2
+                )
+                stop = _threading.Event()
+                peak = [0]
+
+                def sample():
+                    while not stop.is_set():
+                        peak[0] = max(peak[0], gw.scheduler.slots_in_use())
+                        time.sleep(0.01)
+
+                th = _threading.Thread(target=sample, daemon=True)
+                th.start()
+                rep = run_load(
+                    gw.endpoint, rate_hz=rate, duration_s=6.0,
+                    prompt_len=(prompt_len, prompt_len),
+                    max_new=(max_new, max_new), vocab=vocab, seed=seed,
+                )
+                stop.set()
+                th.join(timeout=2)
+                return peak[0], rep
+
+        rate_mem = 2.0 * 4 * seq_tps / max_new
+        dense_peak, dense_rep = _peak_streams(
+            {"kv_layout": "dense", "max_slots": 4}, rate_mem, seed=4
+        )
+        paged_peak, paged_rep = _peak_streams(
+            {"kv_layout": "paged", "max_slots": 16, "page_len": 4,
+             "num_pages": 33, "prefix_cache": False},
+            rate_mem, seed=4,
+        )
+        out.update({
+            "gateway_membudget_rate_rps": round(rate_mem, 2),
+            "gateway_membudget_pages": 32,
+            "gateway_membudget_dense_slots": 4,
+            "gateway_membudget_dense_peak_streams": dense_peak,
+            "gateway_membudget_dense_tokens_per_sec":
+                dense_rep["tokens_per_sec"],
+            "gateway_membudget_dense_errors": dense_rep["errors"],
+            "gateway_membudget_paged_peak_streams": paged_peak,
+            "gateway_membudget_paged_tokens_per_sec":
+                paged_rep["tokens_per_sec"],
+            "gateway_membudget_paged_errors": paged_rep["errors"],
+            "gateway_membudget_paged_gt_dense": bool(
+                paged_peak > dense_peak
+            ),
+        })
+        print(json.dumps(out), flush=True)
     finally:
         shutdown_procs(procs)
+        reset_client_rpc()
+
+    # ---- ISSUE 13 arms: chunked prefill + shared-prefix reuse.  These
+    # need prefill cost PROPORTIONAL to prompt length (a flat reply
+    # latency makes a 48-token prefill as cheap as a decode step, hiding
+    # both effects), so a second server set runs with chaos bandwidth:
+    # reply delay = bytes / bandwidth, bytes ∝ rows.
+    bw_bps = float(os.environ.get("BENCH_GATEWAY_BANDWIDTH", "20000"))
+    procs2, ports2 = spawn_expert_servers(
+        REPO, "gwc", (0.005, 0.005), d_model=d_model, num_experts=2,
+        extra_args=("--chaos-bandwidth", str(bw_bps)),
+    )
+    out["gateway_chaos_bandwidth_bps"] = bw_bps
+    try:
+        source2 = StaticExpertSource({
+            f"gwc{layer}.{e}": ("127.0.0.1", ports2[layer])
+            for layer in range(n_layers) for e in range(2)
+        })
+        cfg2 = SwarmTransformerConfig(
+            vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+            n_heads=4, seq_len=96, grid_size=(2,), k_best=2, k_min=2,
+            uid_prefix="gwc", timeout_after_k_min=30.0,
+            forward_timeout=60.0, backward_timeout=60.0,
+            wire_codec="none", routing_cost_weight=0,
+        )
+        model2 = SwarmDMoETransformerLM(cfg2, source2)
+        params2 = model2.init_params(jax.random.PRNGKey(0))
+        mixed_dist = [("short", 4, 8, 0.8), ("long", 40, 56, 0.2)]
+
+        # chunked-vs-serial prefill: the mixed workload's SHORT bucket
+        # measures running-stream ITL; on the serial arm every long
+        # prompt's whole prefill blocks the decode loop, on the chunked
+        # arm it is interleaved in 8-token slices.  Acceptance: chunked
+        # short-bucket ITL p99 strictly below serial.
+        def prefill_arm(label: str, chunk: int, seed: int) -> dict:
+            with Gateway(
+                model2, params2, max_slots=slots, coalesce=True,
+                max_pending=64, prefill_chunk_tokens=chunk,
+            ) as gw:
+                GatewayClient(gw.endpoint).generate([1, 2, 3, 4], 2)
+                rep = run_load(
+                    gw.endpoint, rate_hz=3.0, duration_s=duration,
+                    prompt_len_dist=mixed_dist, max_new=(8, 12),
+                    vocab=vocab, seed=seed,
+                )
+            short = rep["buckets"]["short"]
+            return {
+                f"gateway_{label}_short_itl_p50_ms": short["itl_p50_ms"],
+                f"gateway_{label}_short_itl_p99_ms": short["itl_p99_ms"],
+                f"gateway_{label}_short_ttft_p50_ms": short["ttft_p50_ms"],
+                f"gateway_{label}_long_ttft_p50_ms":
+                    rep["buckets"]["long"]["ttft_p50_ms"],
+                f"gateway_{label}_completed": rep["completed"],
+                f"gateway_{label}_errors": rep["errors"],
+                f"gateway_{label}_crashes": rep["crashes"],
+                f"gateway_{label}_tokens_per_sec": rep["tokens_per_sec"],
+            }
+
+        out.update(prefill_arm("prefill_serial", 0, seed=5))
+        out.update(prefill_arm("prefill_chunked", 8, seed=5))
+        out["gateway_chunked_itl_p99_below_serial"] = bool(
+            out["gateway_prefill_chunked_short_itl_p99_ms"]
+            < out["gateway_prefill_serial_short_itl_p99_ms"]
+        )
+        print(json.dumps(out), flush=True)
+
+        # shared-prefix TTFT: every prompt opens with one fixed 32-token
+        # prefix (2 full 16-token pages).  With the prefix cache those
+        # pages prefill once and every later stream maps them; without
+        # it every stream pays the full prompt.  Same seed both arms.
+        def prefix_arm(label: str, enable: bool) -> dict:
+            with Gateway(
+                model2, params2, max_slots=slots, coalesce=True,
+                max_pending=64, prefix_cache=enable,
+            ) as gw:
+                client = GatewayClient(gw.endpoint)
+                client.generate([1, 2, 3, 4], 2)
+                # warm pass: registers the shared-prefix pages on the
+                # cache arm (a no-op for the disabled arm), so the
+                # measured window prices steady-state reuse
+                run_load(
+                    gw.endpoint, rate_hz=2.0, duration_s=1.0,
+                    prompt_len=(40, 40), max_new=(4, 4), vocab=vocab,
+                    seed=6, prefix_share=1.0, prefix_len=32,
+                )
+                rep = run_load(
+                    gw.endpoint, rate_hz=2.0, duration_s=5.0,
+                    prompt_len=(40, 40), max_new=(4, 6), vocab=vocab,
+                    seed=6, prefix_share=1.0, prefix_len=32,
+                )
+                kv = gw.decoder.kv_stats()
+            return {
+                f"gateway_{label}_ttft_p50_ms": rep["ttft_p50_ms"],
+                f"gateway_{label}_ttft_p99_ms": rep["ttft_p99_ms"],
+                f"gateway_{label}_completed": rep["completed"],
+                f"gateway_{label}_errors": rep["errors"],
+                f"gateway_{label}_prefix_hits":
+                    kv.get("prefix_hits_total", 0),
+                f"gateway_{label}_prefix_hit_tokens":
+                    kv.get("prefix_hit_tokens_total", 0),
+            }
+
+        out.update(prefix_arm("prefix_on", True))
+        out.update(prefix_arm("prefix_off", False))
+        out["gateway_prefix_ttft_p50_improved"] = bool(
+            out["gateway_prefix_on_prefix_hits"] > 0
+            and out["gateway_prefix_on_ttft_p50_ms"]
+            < out["gateway_prefix_off_ttft_p50_ms"]
+        )
+    finally:
+        shutdown_procs(procs2)
         reset_client_rpc()
     faulthandler.cancel_dump_traceback_later()
     print(json.dumps(out), flush=True)
 
 
-def run_gateway_bench(deadline: int = 420) -> dict | None:
+def run_gateway_bench(deadline: int = 560) -> dict | None:
     """Gateway continuous-batching A/B in a scrubbed CPU subprocess
     (host/DCN tier, accelerator-independent like the dispatch bench)."""
     from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env

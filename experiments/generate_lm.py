@@ -110,8 +110,12 @@ def _swarm_main(p, args) -> None:
             f"prompt ({len(prompt)}) + max_new_tokens "
             f"({args.max_new_tokens}) exceeds seq_len {cfg.seq_len}"
         )
+    kv_kwargs = {"kv_layout": args.kv_layout}
+    if args.kv_layout == "paged":
+        kv_kwargs["page_len"] = args.page_len
     try:
-        dec = SwarmKVDecoder(model, params, max_slots=args.batch)
+        dec = SwarmKVDecoder(model, params, max_slots=args.batch,
+                             **kv_kwargs)
         outs = dec.generate([prompt] * args.batch, args.max_new_tokens)
         text = bytes(t for t in outs[0] if t < 256).decode(
             "utf-8", errors="replace"
@@ -121,7 +125,8 @@ def _swarm_main(p, args) -> None:
             n = args.bench
             if len(prompt) + n > cfg.seq_len:
                 raise SystemExit(f"--bench {n} exceeds seq_len headroom")
-            bench_dec = SwarmKVDecoder(model, params, max_slots=args.batch)
+            bench_dec = SwarmKVDecoder(model, params, max_slots=args.batch,
+                                       **kv_kwargs)
             t0 = time.perf_counter()
             bench_dec.generate([prompt] * args.batch, n)
             dt = time.perf_counter() - t0
@@ -131,6 +136,7 @@ def _swarm_main(p, args) -> None:
                 "mode": "swarm",
                 "batch": args.batch,
                 "seq_len": cfg.seq_len,
+                "kv_layout": args.kv_layout,
             }), flush=True)
     finally:
         reset_client_rpc()
@@ -159,6 +165,13 @@ def main() -> None:
     p.add_argument("--swarm", action="store_true",
                    help="decode against live expert servers (the gateway's "
                         "KV decoder) instead of the pod-mode model")
+    p.add_argument("--kv-layout", choices=("dense", "paged"),
+                   default="dense",
+                   help="[swarm] KV cache layout: the static per-slot "
+                        "table, or the paged pool the gateway serves "
+                        "from (bitwise-identical tokens either way)")
+    p.add_argument("--page-len", type=int, default=16,
+                   help="[swarm] tokens per KV page for --kv-layout paged")
     p.add_argument("--expert-server", action="append", default=[],
                    metavar="HOST:PORT",
                    help="[swarm] expert server endpoint; one entry maps "

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Open-loop load generator for the serving gateway (ISSUE 12).
+"""Open-loop load generator for the serving gateway (ISSUE 12/13).
 
 Poisson arrivals at a target rate (exponential inter-arrival gaps — the
 open-loop discipline: arrivals do NOT wait for earlier requests, so a
@@ -16,10 +16,25 @@ JSON report:
 - ``errors`` / ``crashes`` — stream-level error replies vs client-side
   exceptions (the acceptance bar wants zero of the latter at any load).
 
+Workload shaping (ISSUE 13 — the paged-KV/chunked-prefill A/B knobs):
+
+- ``prompt_len_dist`` — a weighted mixture of named length buckets
+  (``[("short", 4, 12, 0.8), ("long", 40, 80, 0.2)]``); the report
+  carries TTFT/ITL percentiles PER BUCKET under ``"buckets"``, which is
+  how the bench shows a long prompt's prefill no longer spikes short
+  streams' ITL;
+- ``prefix_share`` / ``prefix_len`` — with probability ``prefix_share``
+  a request's first ``min(prefix_len, len-1)`` tokens are one fixed
+  seed-derived shared prefix (total length still comes from the bucket,
+  so prefix on/off A/Bs compare equal-length work) — the shared-prefix
+  workload the gateway's content-addressed prefix cache accelerates.
+
 Importable (``run_load``) for bench.py / collect_gate.py, or a CLI::
 
     python experiments/loadgen.py --endpoint 127.0.0.1:31400 \
-        --rate 20 --duration 10 --prompt-len 4 12 --max-new 8 16
+        --rate 20 --duration 10 \
+        --prompt-len-dist short:4:12:0.8,long:40:80:0.2 \
+        --prefix-share 0.5 --prefix-len 24
 """
 
 from __future__ import annotations
@@ -42,6 +57,22 @@ def _pct(values, q) -> float:
     return float(np.percentile(np.asarray(values), q)) if values else 0.0
 
 
+def parse_len_dist(spec: str) -> list:
+    """``"short:4:12:0.8,long:40:80:0.2"`` → [(name, lo, hi, weight)]."""
+    out = []
+    for part in spec.split(","):
+        fields = part.strip().split(":")
+        if len(fields) != 4:
+            raise ValueError(
+                f"bucket {part!r} must be name:min:max:weight"
+            )
+        name, lo, hi, w = fields
+        out.append((name, int(lo), int(hi), float(w)))
+    if not out or sum(w for *_x, w in out) <= 0:
+        raise ValueError(f"no usable buckets in {spec!r}")
+    return out
+
+
 def run_load(
     endpoint,
     *,
@@ -53,6 +84,9 @@ def run_load(
     seed: int = 0,
     poll_interval_s: float = 0.005,
     drain_timeout_s: float = 120.0,
+    prompt_len_dist: list = None,
+    prefix_share: float = 0.0,
+    prefix_len: int = 0,
 ) -> dict:
     """Drive one gateway open-loop and return the JSON-ready report.
 
@@ -65,16 +99,34 @@ def run_load(
 
     client = GatewayClient(endpoint)
     rng = np.random.RandomState(seed)
+    if prompt_len_dist is None:
+        prompt_len_dist = [("all", prompt_len[0], prompt_len[1], 1.0)]
+    weights = np.asarray([w for *_x, w in prompt_len_dist], float)
+    weights = weights / weights.sum()
+    # the shared prefix is derived from the seed ONLY — every run_load
+    # with the same seed targets the same resident pages, which is what
+    # lets a warm gateway show cross-run prefix hits
+    prefix_rng = np.random.RandomState(seed + 104729)
+    shared_prefix = (
+        prefix_rng.randint(0, vocab, size=max(0, int(prefix_len))).tolist()
+        if prefix_len > 0 else []
+    )
     lock = threading.Lock()
     report = {
         "arrivals": 0, "completed": 0, "shed": 0, "shed_with_retry_after": 0,
         "errors": 0, "crashes": 0, "tokens_served": 0,
+        "prefix_share": float(prefix_share), "prefix_len": int(prefix_len),
     }
     ttfts: list[float] = []
     itls: list[float] = []
+    buckets = {
+        name: {"arrivals": 0, "completed": 0, "shed": 0,
+               "ttfts": [], "itls": []}
+        for name, *_rest in prompt_len_dist
+    }
     threads: list[threading.Thread] = []
 
-    def one_request(prompt, n_new) -> None:
+    def one_request(prompt, n_new, bucket) -> None:
         token_times: list[float] = []
         t_submit = time.monotonic()
         try:
@@ -91,6 +143,7 @@ def run_load(
         with lock:
             if out.get("shed"):
                 report["shed"] += 1
+                buckets[bucket]["shed"] += 1
                 # a well-formed shed carries a positive retry-after —
                 # the overload acceptance bar checks this count == shed
                 ra = out.get("retry_after_s")
@@ -101,10 +154,14 @@ def run_load(
                 report["errors"] += 1
                 return
             report["completed"] += 1
+            buckets[bucket]["completed"] += 1
             report["tokens_served"] += len(out["tokens"])
             if token_times:
                 ttfts.append(token_times[0] - t_submit)
-                itls.extend(np.diff(token_times).tolist())
+                buckets[bucket]["ttfts"].append(token_times[0] - t_submit)
+                gaps = np.diff(token_times).tolist()
+                itls.extend(gaps)
+                buckets[bucket]["itls"].extend(gaps)
 
     t0 = time.monotonic()
     deadline = t0 + duration_s
@@ -113,21 +170,43 @@ def run_load(
         delay = next_arrival - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        p_len = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
+        b = int(rng.choice(len(prompt_len_dist), p=weights))
+        name, lo, hi, _w = prompt_len_dist[b]
+        p_len = int(rng.randint(lo, hi + 1))
         n_new = int(rng.randint(max_new[0], max_new[1] + 1))
         prompt = rng.randint(0, vocab, size=p_len).tolist()
+        if shared_prefix and rng.random_sample() < prefix_share:
+            # keep the TOTAL length from the bucket so prefix on/off
+            # A/Bs compare equal-length work; at least one tail token
+            # stays private (the cache never skips the final position)
+            k = min(len(shared_prefix), p_len - 1)
+            if k > 0:
+                prompt = shared_prefix[:k] + prompt[k:]
         th = threading.Thread(
-            target=one_request, args=(prompt, n_new), daemon=True
+            target=one_request, args=(prompt, n_new, name), daemon=True
         )
         th.start()
         threads.append(th)
         report["arrivals"] += 1
+        buckets[name]["arrivals"] += 1
         next_arrival += float(rng.exponential(1.0 / rate_hz))
     for th in threads:
         th.join(timeout=drain_timeout_s)
     wall = time.monotonic() - t0
     with lock:
         out = dict(report)
+        bucket_rows = {
+            name: {
+                "arrivals": rec["arrivals"],
+                "completed": rec["completed"],
+                "shed": rec["shed"],
+                "ttft_p50_ms": round(_pct(rec["ttfts"], 50) * 1e3, 1),
+                "ttft_p99_ms": round(_pct(rec["ttfts"], 99) * 1e3, 1),
+                "itl_p50_ms": round(_pct(rec["itls"], 50) * 1e3, 1),
+                "itl_p99_ms": round(_pct(rec["itls"], 99) * 1e3, 1),
+            }
+            for name, rec in buckets.items()
+        }
     out.update(
         rate_hz=rate_hz,
         duration_s=duration_s,
@@ -140,6 +219,7 @@ def run_load(
         ttft_p99_ms=round(_pct(ttfts, 99) * 1e3, 1),
         itl_p50_ms=round(_pct(itls, 50) * 1e3, 1),
         itl_p99_ms=round(_pct(itls, 99) * 1e3, 1),
+        buckets=bucket_rows,
     )
     return out
 
@@ -154,6 +234,16 @@ def main(argv=None) -> int:
                     help="arrival window, seconds (drain not included)")
     ap.add_argument("--prompt-len", type=int, nargs=2, default=(4, 12),
                     metavar=("MIN", "MAX"))
+    ap.add_argument("--prompt-len-dist", type=str, default=None,
+                    help="weighted length buckets, e.g. "
+                         "'short:4:12:0.8,long:40:80:0.2' "
+                         "(overrides --prompt-len; per-bucket TTFT/ITL "
+                         "percentiles are reported)")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    help="fraction of requests whose prompt starts with "
+                         "the fixed seed-derived shared prefix")
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="length of the shared prefix (tokens)")
     ap.add_argument("--max-new", type=int, nargs=2, default=(8, 16),
                     metavar=("MIN", "MAX"))
     ap.add_argument("--vocab", type=int, default=258)
@@ -170,6 +260,12 @@ def main(argv=None) -> int:
         max_new=tuple(args.max_new),
         vocab=args.vocab,
         seed=args.seed,
+        prompt_len_dist=(
+            parse_len_dist(args.prompt_len_dist)
+            if args.prompt_len_dist else None
+        ),
+        prefix_share=args.prefix_share,
+        prefix_len=args.prefix_len,
     )
     print(json.dumps(report))
     return 0

@@ -364,9 +364,16 @@ class RemoteMixtureOfExperts:
 
     # ---- the public call: gating in-graph, dispatch via host callback ----
 
-    def __call__(self, x, gate_params: dict):
+    def gate_logits(self, gate_params: dict, x):
+        """Concatenated per-dimension gate logits [B, sum(grid)] — THE
+        gating math, shared by :meth:`__call__`, the fire half and the
+        gateway decode hooks (swarm_decoder / coalescer) so expert
+        selection cannot drift between training and serving paths."""
         logits = [x @ gate_params[f"w{d}"] for d in range(self.n_dims)]
-        logits_concat = jnp.concatenate(logits, axis=-1)  # [B, sum(grid)]
+        return jnp.concatenate(logits, axis=-1)
+
+    def __call__(self, x, gate_params: dict):
+        logits_concat = self.gate_logits(gate_params, x)  # [B, sum(grid)]
         y, idx, mask = self._dispatch(x, logits_concat)
         return self._combine(y, idx, mask, logits_concat)
 
@@ -434,8 +441,7 @@ class RemoteMixtureOfExperts:
         the caller computes between fire and join overlaps the in-flight
         expert RPCs (the ScMoE-style scheduling the overlapped swarm
         step exploits — models/transformer_swarm.py)."""
-        logits = [x @ gate_params[f"w{d}"] for d in range(self.n_dims)]
-        logits_concat = jnp.concatenate(logits, axis=-1)
+        logits_concat = self.gate_logits(gate_params, x)
         token, handle = self._fire_op(x, logits_concat)
         return token, handle, logits_concat
 

@@ -9,12 +9,15 @@ from learning_at_home_tpu.gateway.admission import AdmissionController
 from learning_at_home_tpu.gateway.coalesce import ExpertCoalescer
 from learning_at_home_tpu.gateway.frontdoor import Gateway, GatewayClient
 from learning_at_home_tpu.gateway.scheduler import SlotScheduler, StreamState
+from learning_at_home_tpu.models.kv_pages import PagedKVCache, PagePressure
 
 __all__ = [
     "AdmissionController",
     "ExpertCoalescer",
     "Gateway",
     "GatewayClient",
+    "PagePressure",
+    "PagedKVCache",
     "SlotScheduler",
     "StreamState",
 ]

@@ -1,6 +1,6 @@
 """Admission control: shed load BEFORE quality collapses.
 
-Two saturation signals, both cheap to read at admit time:
+Three saturation signals, all cheap to read at admit time:
 
 - **gateway occupancy** — pending streams waiting for a slot.  Slots
   full is normal (that is what continuous batching is for); an unbounded
@@ -12,6 +12,18 @@ Two saturation signals, both cheap to read at admit time:
   (utils/telemetry.py, the same feed PR 8's routing cost model eats).
   When the WORST advertised queue exceeds ``max_server_queue``, admitting
   more decode work would pile onto servers that are already drowning.
+- **KV page pressure** (paged decoder only) — a stream that cannot get
+  the physical pages its prompt + budget will occupy would only churn
+  the preemption path; when ``pages_needed`` exceeds the pool's free +
+  reclaimable headroom (net of a one-page-per-active-slot reserve), the
+  gateway sheds with a retry-after instead.  The headroom read is a
+  plain-int peek at counters the ``lah-gw-decode`` thread owns — the
+  same benign monitoring race as the slot mask, no lock
+  (docs/CONCURRENCY.md invariant 12).
+
+Shedding is ALWAYS a well-formed busy frame carrying ``retry_after_s``
+(docs/PROTOCOL.md "Gateway RPC family"), never an error frame — page
+exhaustion is backpressure, not failure.
 
 The DHT read is a blocking control-plane round trip, so it runs on this
 controller's own ``lah-gw-admission`` daemon thread on a fixed period;
@@ -60,6 +72,7 @@ class AdmissionController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.shed_total = 0
+        self.shed_pages_total = 0
         self.admitted_total = 0
         self.load_refresh_failures = 0
 
@@ -106,9 +119,12 @@ class AdmissionController:
 
     # ---- the admit-time decision (event-loop safe: no I/O, no waits) ----
 
-    def admit(self) -> tuple[bool, Optional[float], Optional[str]]:
+    def admit(
+        self, pages_needed: int = 0
+    ) -> tuple[bool, Optional[float], Optional[str]]:
         """(accepted, retry_after_s, reason).  retry_after_s/reason are
-        None on accept."""
+        None on accept.  ``pages_needed`` is the stream's peak KV page
+        footprint (0 = dense decoder / skip the page check)."""
         pending = self.scheduler.pending_count()
         if pending >= self.max_pending:
             self.shed_total += 1
@@ -126,6 +142,17 @@ class AdmissionController:
                 f"expert servers saturated: worst advertised queue depth "
                 f"{self._server_queue_depth:.0f} > {self.max_server_queue:.0f}",
             )
+        if pages_needed > 0:
+            headroom = self.scheduler.free_page_headroom()
+            if headroom is not None and pages_needed > headroom:
+                self.shed_total += 1
+                self.shed_pages_total += 1
+                return (
+                    False,
+                    self.scheduler.estimate_retry_after_s(),
+                    f"KV page pressure: stream needs {pages_needed} pages, "
+                    f"pool headroom {max(0, headroom)}",
+                )
         self.admitted_total += 1
         return True, None, None
 
@@ -135,6 +162,7 @@ class AdmissionController:
             "max_server_queue": self.max_server_queue,
             "server_queue_depth": self._server_queue_depth,
             "shed_total": self.shed_total,
+            "shed_pages_total": self.shed_pages_total,
             "admitted_total": self.admitted_total,
             "load_refresh_failures": self.load_refresh_failures,
         }

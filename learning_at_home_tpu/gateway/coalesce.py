@@ -50,10 +50,7 @@ class ExpertCoalescer:
     # decoder hook signature: (layer, moe, gate_params, x_rows, row_streams)
     def dispatch(self, layer, moe, gate_params, x_rows, row_streams):
         x_rows = jnp.asarray(x_rows)
-        logits_concat = jnp.concatenate(
-            [x_rows @ gate_params[f"w{d}"] for d in range(moe.n_dims)],
-            axis=-1,
-        )
+        logits_concat = moe.gate_logits(gate_params, x_rows)
         x_np = np.asarray(x_rows)
         logits_np = np.asarray(logits_concat)
         # stream -> its row indices, first-appearance order (prefill hands

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Optional
 
@@ -60,9 +61,12 @@ GATEWAY_FEATURES = ("mux",)
 class Gateway:
     """Front-door serving process over one swarm model.
 
-    Owns the whole serving stack: decoder (static-shape KV slots),
-    coalescer (cross-user expert-set grouping), scheduler (continuous
-    batching on ``lah-gw-decode``), admission controller, the
+    Owns the whole serving stack: decoder (paged KV pool with
+    shared-prefix reuse by default; ``kv_layout="dense"`` keeps the
+    static slot table), coalescer (cross-user expert-set grouping),
+    scheduler (continuous batching with chunked prefill on
+    ``lah-gw-decode``), admission controller (slots, server queues AND
+    free-page headroom), the
     ``lah-gateway`` serving loop, a metrics-registry collector, and —
     when a DHT handle is passed — a ``telemetry.<prefix>`` heartbeat with
     role ``gateway`` so ``lah_top`` renders it as a first-class peer.
@@ -82,15 +86,31 @@ class Gateway:
         max_pending: Optional[int] = None,
         max_server_queue: float = 64.0,
         stream_ttl_s: Optional[float] = None,
+        kv_layout: str = "paged",
+        page_len: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         self.model = model
         self.coalescer = ExpertCoalescer(coalesce=coalesce)
+        if page_len is None:
+            try:
+                page_len = int(os.environ.get("LAH_GW_PAGE_LEN", "16"))
+            except ValueError:
+                page_len = 16
+        # the gateway defaults to the paged layout (bounded by tokens in
+        # flight, prefix reuse, chunked prefill); kv_layout="dense" keeps
+        # the PR-12 slot table as the bench/parity baseline
         self.decoder = SwarmKVDecoder(
             model, params, max_slots=max_slots,
             moe_dispatch=self.coalescer.dispatch,
+            kv_layout=kv_layout, page_len=page_len, num_pages=num_pages,
+            prefix_cache=prefix_cache,
         )
         self.scheduler = SlotScheduler(
-            self.decoder, stream_ttl_s=stream_ttl_s
+            self.decoder, stream_ttl_s=stream_ttl_s,
+            prefill_chunk_tokens=prefill_chunk_tokens,
         )
         # server-load feed: the MoE's own cost model already TTL-caches
         # the load.<prefix> heartbeats (PR 8) — reuse it instead of
@@ -177,7 +197,7 @@ class Gateway:
 
     def _collect(self) -> dict:
         s = self.scheduler
-        return {
+        out = {
             "lah_gateway_streams_total": s.streams_total,
             "lah_gateway_streams_finished_total": s.streams_finished_total,
             "lah_gateway_streams_errored_total": s.streams_errored_total,
@@ -187,12 +207,31 @@ class Gateway:
             "lah_gateway_slots_in_use": s.slots_in_use(),
             "lah_gateway_tokens_total": s.tokens_total,
             "lah_gateway_shed_total": self.admission.shed_total,
+            "lah_gateway_shed_pages_total": self.admission.shed_pages_total,
             "lah_gateway_group_dispatches_total":
                 self.coalescer.group_dispatches_total,
             "lah_gateway_coalesced_dispatches_total":
                 self.coalescer.coalesced_dispatches_total,
             "lah_gateway_step_time_ema_s": s.step_time_ema or 0.0,
+            "lah_gateway_preemptions_total": s.preemptions_total,
+            "lah_gateway_prefill_chunks_total":
+                self.decoder.prefill_chunks_total,
         }
+        kv = self.decoder.kv
+        if kv is not None:
+            out.update({
+                "lah_gateway_kv_pages_total": kv.pages_total(),
+                "lah_gateway_kv_pages_used": kv.pages_used(),
+                "lah_gateway_kv_pages_reclaimable": kv.pages_reclaimable(),
+                "lah_gateway_kv_page_len": kv.page_len,
+                "lah_gateway_prefix_hits_total": kv.prefix_hits_total,
+                "lah_gateway_prefix_hit_tokens_total":
+                    kv.prefix_hit_tokens_total,
+                "lah_gateway_cow_copies_total": kv.cow_copies_total,
+                "lah_gateway_kv_pages_reclaimed_total":
+                    kv.pages_reclaimed_total,
+            })
+        return out
 
     # ---- the serving loop (lah-gateway) ----
 
@@ -314,21 +353,44 @@ class Gateway:
         if not (
             isinstance(prompt, (list, tuple))
             and prompt
-            and all(isinstance(t, int) and 0 <= t < vocab for t in prompt)
+            and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                and 0 <= t < vocab for t in prompt
+            )
         ):
             raise ValueError(
                 "prompt must be a non-empty list of token ids in "
                 f"[0, {vocab})"
             )
-        if not isinstance(max_new, int) or max_new < 1:
+        if (
+            not isinstance(max_new, int) or isinstance(max_new, bool)
+            or max_new < 1
+        ):
             raise ValueError("max_new_tokens must be a positive int")
+        # an over-long prompt is a well-formed error frame BEFORE the
+        # stream table sees it — it must never reach the decode thread,
+        # where it could only crash prefill or wedge the pending queue
         capacity = self.decoder.seq_len - len(prompt)
         if capacity < 1:
             raise ValueError(
                 f"prompt length {len(prompt)} leaves no decode capacity "
                 f"(cache holds {self.decoder.seq_len} positions)"
             )
-        accepted, retry_after_s, reason = self.admission.admit()
+        max_new = min(max_new, capacity)
+        pages_needed = self.decoder.pages_needed(len(prompt), max_new)
+        if (
+            self.decoder.kv is not None
+            and self.decoder.pages_needed(len(prompt) + 1)
+            > self.decoder.kv.pages_total()
+        ):
+            raise ValueError(
+                f"prompt needs {self.decoder.pages_needed(len(prompt) + 1)}"
+                f" KV pages but the pool holds "
+                f"{self.decoder.kv.pages_total()}"
+            )
+        accepted, retry_after_s, reason = self.admission.admit(
+            pages_needed=pages_needed
+        )
         if not accepted:
             return {
                 "accepted": False,
@@ -336,9 +398,7 @@ class Gateway:
                 "retry_after_s": retry_after_s,
                 "message": reason,
             }
-        sid = self.scheduler.submit(
-            prompt, min(max_new, capacity)
-        )
+        sid = self.scheduler.submit(prompt, max_new)
         return {"accepted": True, "sid": sid}
 
 

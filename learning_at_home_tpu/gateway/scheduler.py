@@ -1,17 +1,33 @@
 """Continuous-batching slot scheduler: the gateway's decode engine.
 
 One dedicated daemon thread (``lah-gw-decode``) EXCLUSIVELY owns the
-:class:`SwarmKVDecoder` — its slot table, KV caches and per-slot scalars
-are never touched from any other thread or loop (docs/CONCURRENCY.md).
-The loop it runs is the whole continuous-batching policy:
+:class:`SwarmKVDecoder` — its slot table, KV caches/page pool and
+per-slot scalars are never touched from any other thread or loop
+(docs/CONCURRENCY.md invariant 12).  The loop it runs is the whole
+continuous-batching policy:
 
-1. evict streams cancelled since the last pass (slot + KV rows freed);
-2. admit pending streams into free slots (one prefill each — prefill is
-   serial, decode is batched, the standard continuous-batching split);
-3. one :meth:`decode_step` advances EVERY live stream by one token —
+1. evict streams cancelled since the last pass (slot + KV pages freed);
+2. admit pending streams into free slots — under the paged layout this
+   only CLAIMS the slot and serves the prefix cache
+   (:meth:`begin_prefill`); the prompt forward itself runs in step 3.
+   With ``prefill_chunk_tokens=0`` (or a dense decoder) admission does
+   the whole prefill serially, the PR-12 legacy behaviour kept as the
+   bench A/B arm;
+3. **chunked prefill**: a fixed token budget per pass is spent
+   round-robin across mid-prefill slots (:meth:`prefill_step`), so one
+   long prompt costs every running stream at most one chunk of extra
+   inter-token latency instead of its whole prefill;
+4. one :meth:`decode_step` advances EVERY live stream by one token —
    arrivals join at token boundaries, nothing waits for a batch drain;
-4. streams that hit their token budget or cache capacity vacate their
+5. streams that hit their token budget or cache capacity vacate their
    slot immediately.
+
+Page pressure (paged layout only) is resolved by **preemption and
+recompute**: the youngest stream that cannot get a page is evicted and
+requeued at the FRONT of the pending queue with an effective prompt of
+``prompt + tokens-so-far`` — greedy decoding makes the recomputed
+continuation token-identical, so clients only ever observe added
+latency, never changed output.
 
 Everything the FRONT DOOR touches (the stream table, the pending queue,
 per-stream token buffers) is guarded by the ``gateway.streams`` lock with
@@ -32,11 +48,13 @@ import uuid
 from collections import deque
 from typing import Optional
 
+from learning_at_home_tpu.models.kv_pages import PagePressure
 from learning_at_home_tpu.utils import sanitizer
 
 logger = logging.getLogger(__name__)
 
 _DEFAULT_STREAM_TTL_S = 600.0
+_DEFAULT_PREFILL_CHUNK = 32
 
 
 @dataclasses.dataclass
@@ -49,6 +67,7 @@ class StreamState:
     error: Optional[str] = None
     cancelled: bool = False
     slot: Optional[int] = None
+    prefilling: bool = False
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -63,6 +82,7 @@ class SlotScheduler:
         *,
         idle_wait_s: float = 0.02,
         stream_ttl_s: Optional[float] = None,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         self.decoder = decoder
         self.idle_wait_s = idle_wait_s
@@ -75,6 +95,21 @@ class SlotScheduler:
             except ValueError:
                 stream_ttl_s = _DEFAULT_STREAM_TTL_S
         self.stream_ttl_s = stream_ttl_s
+        if prefill_chunk_tokens is None:
+            try:
+                prefill_chunk_tokens = int(
+                    os.environ.get("LAH_GW_PREFILL_CHUNK",
+                                   str(_DEFAULT_PREFILL_CHUNK))
+                )
+            except ValueError:
+                prefill_chunk_tokens = _DEFAULT_PREFILL_CHUNK
+        # 0 = serial prefill at admission (legacy/bench arm); chunking
+        # also needs a paged decoder
+        self.prefill_chunk_tokens = max(0, int(prefill_chunk_tokens))
+        self.chunked = (
+            self.decoder.supports_chunked_prefill
+            and self.prefill_chunk_tokens > 0
+        )
         self._lock = sanitizer.lock("gateway.streams")
         self._streams: dict[str, StreamState] = {}
         self._pending: deque[str] = deque()
@@ -83,12 +118,14 @@ class SlotScheduler:
         self._thread: Optional[threading.Thread] = None
         self._sid_counter = itertools.count()
         self._sid_salt = uuid.uuid4().hex[:6]
+        self._prefill_rr = 0  # round-robin cursor over mid-prefill slots
         # counters (read by metrics collector / stats; guarded by _lock)
         self.streams_total = 0
         self.streams_finished_total = 0
         self.streams_errored_total = 0
         self.streams_cancelled_total = 0
         self.tokens_total = 0
+        self.preemptions_total = 0
         # decode-step wall time EMA (seconds) — the admission controller's
         # retry-after scale
         self.step_time_ema: Optional[float] = None
@@ -166,9 +203,17 @@ class SlotScheduler:
             )
 
     def slots_in_use(self) -> int:
-        # reading the decoder's live mask from another thread is a benign
-        # monitoring race (numpy bool reads tear at element granularity)
-        return int(self.decoder.live.sum())
+        # reading the decoder's live/prefilling masks from another thread
+        # is a benign monitoring race (numpy bool reads tear at element
+        # granularity)
+        return int((self.decoder.live | self.decoder.prefilling).sum())
+
+    def free_page_headroom(self) -> Optional[int]:
+        """Free+reclaimable pages net of the active-slot reserve (None on
+        a dense decoder) — the admission controller's page-pressure
+        signal.  Plain-int reads of decode-thread-owned counters: benign
+        monitoring, no lock (CONCURRENCY.md invariant 12)."""
+        return self.decoder.free_page_headroom()
 
     def estimate_retry_after_s(self) -> float:
         """Best-effort hint for shed replies: how long until a slot is
@@ -188,7 +233,7 @@ class SlotScheduler:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "streams_total": self.streams_total,
                 "streams_finished_total": self.streams_finished_total,
                 "streams_errored_total": self.streams_errored_total,
@@ -201,7 +246,14 @@ class SlotScheduler:
                 "slots": self.decoder.max_slots,
                 "slots_in_use": self.slots_in_use(),
                 "step_time_ema_s": self.step_time_ema,
+                "prefill_chunk_tokens": (
+                    self.prefill_chunk_tokens if self.chunked else 0
+                ),
+                "prefill_chunks_total": self.decoder.prefill_chunks_total,
+                "preemptions_total": self.preemptions_total,
             }
+        out.update(self.decoder.kv_stats())
+        return out
 
     # ---- the decode loop (lah-gw-decode thread ONLY below here) ----
 
@@ -222,7 +274,8 @@ class SlotScheduler:
         now = time.monotonic()
         self._evict_cancelled(now)
         self._admit_pending(now)
-        worked = self._decode_once(now)
+        worked = self._prefill_chunks(now)
+        worked = self._decode_once(now) or worked
         if now - self._last_gc > max(1.0, self.stream_ttl_s / 10):
             self._gc_streams(now)
             self._last_gc = now
@@ -242,6 +295,7 @@ class SlotScheduler:
                 st.slot = None
                 return
             st.slot = None
+            st.prefilling = False
             st.done = True
             st.finished_at = now
             if error is not None:
@@ -261,6 +315,26 @@ class SlotScheduler:
         for st in doomed:
             self._finish(st, now, cancelled=True)
 
+    def _effective_prompt(self, st: StreamState) -> list:
+        """What prefill must run for st: the submitted prompt plus every
+        token already delivered (non-empty after a preemption — greedy
+        decoding makes the recomputed continuation identical, so the
+        requeue is invisible to the client beyond latency)."""
+        with self._lock:
+            return list(st.prompt) + [int(t) for t in st.tokens]
+
+    def _prompt_can_ever_fit(self, n_tokens: int) -> bool:
+        """False when a prompt needs more pages than the WHOLE pool —
+        requeueing it would livelock admission forever (+1: the stream
+        must be able to decode at least one token past the prompt)."""
+        kv = getattr(self.decoder, "kv", None)
+        if kv is None:
+            return True
+        need = self.decoder.pages_needed(
+            min(n_tokens + 1, self.decoder.seq_len)
+        )
+        return need <= kv.pages_total()
+
     def _admit_pending(self, now: float) -> None:
         while True:
             free = self.decoder.free_slots()
@@ -274,27 +348,178 @@ class SlotScheduler:
             if st.cancelled:
                 self._finish(st, now, cancelled=True)
                 continue
+            prompt = self._effective_prompt(st)
+            if not self._prompt_can_ever_fit(len(prompt)):
+                self._finish(
+                    st, now,
+                    error=(
+                        f"prompt needs {self.decoder.pages_needed(len(prompt))}"
+                        f" KV pages but the pool holds "
+                        f"{self.decoder.kv.pages_total()}"
+                    ),
+                )
+                continue
+            if self.chunked:
+                try:
+                    self.decoder.begin_prefill(
+                        free[0], prompt, stream_id=st.sid
+                    )
+                except PagePressure:
+                    # not even the prefix-cache boundary copy fits right
+                    # now — requeue at the front and let decode/prefill
+                    # progress free pages
+                    with self._lock:
+                        self._pending.appendleft(st.sid)
+                    return
+                except Exception as e:
+                    logger.exception("begin_prefill failed for stream %s",
+                                     st.sid)
+                    self._finish(st, now, error=f"{type(e).__name__}: {e}")
+                    continue
+                with self._lock:
+                    st.slot = free[0]
+                    st.prefilling = True
+                continue
+            # serial prefill (dense decoder, or chunking disabled for the
+            # legacy bench arm)
             try:
                 tok = self.decoder.prefill_into_slot(
-                    free[0], st.prompt, stream_id=st.sid
+                    free[0], prompt, stream_id=st.sid
                 )
+            except PagePressure:
+                self.decoder.evict(free[0])
+                with self._lock:
+                    self._pending.appendleft(st.sid)
+                return
             except Exception as e:
                 logger.exception("prefill failed for stream %s", st.sid)
                 self._finish(st, now, error=f"{type(e).__name__}: {e}")
                 continue
-            with self._lock:
-                st.slot = free[0]
+            self._stream_got_token(st, free[0], tok, now)
+
+    def _stream_got_token(self, st: StreamState, slot: int, tok: int,
+                          now: float) -> None:
+        """A prefill produced st's next token: record it, turn the slot
+        live on the table side, finish if the budget is already met."""
+        with self._lock:
+            st.slot = slot
+            st.prefilling = False
+            if st.first_token_at is None:
                 st.first_token_at = time.monotonic()
-                st.tokens.append(tok)
-                self.tokens_total += 1
-                full = (
-                    len(st.tokens) >= st.max_new_tokens
-                    or self.decoder.at_capacity(free[0])
+            st.tokens.append(tok)
+            self.tokens_total += 1
+            full = (
+                len(st.tokens) >= st.max_new_tokens
+                or self.decoder.at_capacity(slot)
+            )
+        if full:
+            self._finish(st, now)
+
+    def _prefill_chunks(self, now: float) -> bool:
+        """Spend one pass's prefill token budget round-robin across
+        mid-prefill slots — the interleave that keeps running-stream ITL
+        flat while long prompts prefill."""
+        if not self.chunked:
+            return False
+        budget = self.prefill_chunk_tokens
+        slots = self.decoder.prefilling_slots()
+        if not slots:
+            return False
+        rot = self._prefill_rr % len(slots)
+        slots = slots[rot:] + slots[:rot]
+        self._prefill_rr += 1
+        worked = False
+        for slot, sid in slots:
+            if budget <= 0:
+                break
+            with self._lock:
+                st = self._streams.get(sid)
+                # a PagePressure earlier in THIS pass may have preempted
+                # this very stream — its snapshot entry is stale and its
+                # slot already evicted
+                stale = st is not None and (
+                    not st.prefilling or st.slot != slot
                 )
-            if full:
-                self._finish(st, now)
+            if st is None:  # GC'd mid-prefill: free the slot
+                self.decoder.evict(slot)
+                continue
+            if stale:
+                continue
+            if st.cancelled:  # next _evict_cancelled pass finishes it
+                continue
+            try:
+                consumed, tok = self.decoder.prefill_step(slot, budget)
+            except PagePressure:
+                # the raiser is NOT excluded from the victim pool: if it
+                # is itself the youngest slotted stream it gets requeued,
+                # so the oldest stream's progress is monotone and two
+                # mid-prefill streams can never preempt each other
+                # forever (the livelock an exclude-self rule creates)
+                if not self._preempt_one(now):
+                    break  # nothing preemptable; decode will free pages
+                continue  # st retries next pass against the freed pages
+            except Exception as e:
+                logger.exception("prefill chunk failed for stream %s", sid)
+                self._finish(st, now, error=f"{type(e).__name__}: {e}")
+                continue
+            budget -= consumed
+            worked = True
+            if tok is not None:
+                self._stream_got_token(st, slot, tok, now)
+        return worked
+
+    def _preempt_one(self, now: float,
+                     among: Optional[list] = None) -> bool:
+        """Preempt-and-recompute the YOUNGEST victim stream: evict its
+        slot (pages return to the pool) and requeue it at the front with
+        its tokens folded into the prompt.  Decoding victims are
+        preferred over mid-prefill ones (less work to redo per page
+        freed).  A pressure-raising stream may pick ITSELF (it is the
+        youngest): self-preemption is what makes the contention order
+        total — the oldest stream always keeps its pages.  Returns False
+        when there is nothing to preempt."""
+        with self._lock:
+            if among is not None:
+                pool = [st for st in among if not st.done]
+            else:
+                pool = [
+                    st for st in self._streams.values()
+                    if st.slot is not None and not st.done
+                ]
+            decoding = [st for st in pool if not st.prefilling]
+            candidates = decoding or pool
+            if not candidates:
+                return False
+            victim = max(
+                candidates,
+                key=lambda st: st.first_token_at or st.submitted_at,
+            )
+        self.decoder.evict(victim.slot)
+        with self._lock:
+            victim.slot = None
+            victim.prefilling = False
+            self._pending.appendleft(victim.sid)
+        self.preemptions_total += 1
+        logger.info("gateway preempted stream %s under page pressure",
+                    victim.sid)
+        return True
 
     def _decode_once(self, now: float) -> bool:
+        # page pressure first: every live slot must hold a page for its
+        # next position before the batched step
+        while True:
+            lacking = self.decoder.ensure_decode_pages()
+            if not lacking:
+                break
+            with self._lock:
+                lacking_sts = [
+                    st for st in self._streams.values()
+                    if st.slot in lacking and not st.done
+                ]
+            if not lacking_sts or not self._preempt_one(
+                now, among=lacking_sts
+            ):
+                break  # defensive: nothing matched the lacking slots
         live = self.decoder.live_slots()
         if not live:
             return False
@@ -324,6 +549,8 @@ class SlotScheduler:
                 st = self._streams.get(sid)
                 if st is None:  # GC'd mid-flight: free the slot below
                     finished.append((slot, None))
+                    continue
+                if st.slot != slot:  # preempted within this pass
                     continue
                 st.tokens.append(int(nxt[slot]))
                 self.tokens_total += 1

@@ -1,0 +1,353 @@
+"""Paged KV-cache pool + content-addressed prefix cache (ISSUE 13).
+
+The dense slot-table decoder allocates ``max_slots x seq_len`` KV rows
+per layer up front — capacity is burned by the LONGEST possible stream
+even when every live stream is short.  This module replaces that memory
+model with virtual memory for KV caches (the vLLM/Pallas paged-attention
+layout, see /opt/skills/guides/boom_attention_tricks.md §8):
+
+- one static-shape **physical pool** per layer, ``[num_pages, page_len,
+  H, hd]``, allocated once;
+- a per-slot int32 **page table** ``[max_slots, pages_per_slot]`` maps a
+  stream's logical pages to physical pages.  Attention reads go through
+  a jit-friendly gather (:func:`~learning_at_home_tpu.models.trunk.
+  paged_one_query_attention`); slot capacity is bounded by *tokens in
+  flight*, not ``slots x seq_len``;
+- physical page 0 is a reserved **scratch page**: unmapped page-table
+  entries point at it (gathers read finite garbage that the position
+  mask hides) and dead decode rows write their garbage K/V into it
+  instead of corrupting live pages.
+
+On top of the pool sits a **content-addressed prefix cache**: after a
+prompt finishes prefill, every page fully covered by the prompt is
+registered under a chained content hash (page i's key hashes page i-1's
+key + page i's token ids — K/V at position j depends only on tokens
+``<= j``, so the chain IS the content address).  A later prompt that
+walks the same chain maps those physical pages READ-ONLY into its own
+page table and skips prefill for the covered tokens; the boundary page
+(the first page the new stream will *write* — remaining prompt tail,
+then decode tokens) is never shared: a partial content match there is
+served copy-on-write into a fresh private page.
+
+Sharing discipline (the "never aliases a writer" invariant, asserted in
+:meth:`write_tokens`): a physical page with refcount > 1 is immutable.
+Full prompt pages are only written during the prefill that created them
+and are registered afterwards; decode writes always land at positions
+``>= prompt_len``, past every shareable page.
+
+Ownership: like the decoder that embeds it, a pool instance is
+single-threaded by contract — the gateway's ``lah-gw-decode`` thread
+owns page tables, the free list and the prefix index exclusively
+(docs/CONCURRENCY.md invariant 12).  Counters are plain ints that other
+threads may *read* (admission, telemetry) — the same benign monitoring
+race as the decoder's live mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+_ROOT = b"kv-prefix-root"
+
+
+class PagePressure(RuntimeError):
+    """No free physical page and nothing reclaimable — the caller
+    (scheduler/admission) decides whether to requeue, preempt or shed;
+    this is backpressure, never a stream error by itself."""
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    """One registered full page of some prompt's KV content."""
+
+    key: bytes  # chained content hash: H(parent.key + tokens)
+    parent: bytes  # _ROOT for page 0
+    tokens: tuple  # the page_len token ids this page covers
+    page_id: int  # physical page holding the K/V (refcount includes us)
+    last_used: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class PagedKVCache:
+    """Physical page pool + page tables + prefix index for one decoder."""
+
+    def __init__(
+        self,
+        *,
+        n_layers: int,
+        n_heads: int,
+        head_dim: int,
+        dtype,
+        max_slots: int,
+        seq_len: int,
+        page_len: int = 16,
+        num_pages: Optional[int] = None,
+        enable_prefix_cache: bool = True,
+    ):
+        if page_len < 1:
+            raise ValueError("page_len must be >= 1")
+        self.page_len = int(page_len)
+        self.max_slots = int(max_slots)
+        self.seq_len = int(seq_len)
+        self.pages_per_slot = -(-self.seq_len // self.page_len)  # ceil
+        self.padded_seq = self.pages_per_slot * self.page_len
+        if num_pages is None:
+            # dense-equivalent sizing (+1 for the scratch page): a
+            # drop-in pool can always hold what the dense table held.
+            # Memory-bound deployments pass fewer pages and lean on
+            # admission/preemption.
+            num_pages = self.max_slots * self.pages_per_slot + 1
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is scratch)")
+        self.num_pages = int(num_pages)
+        shape = (self.num_pages, self.page_len, n_heads, head_dim)
+        self.k_pools = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self.v_pools = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self.page_table = np.zeros(
+            (self.max_slots, self.pages_per_slot), np.int32
+        )
+        # logical pages present per slot (contiguous from 0)
+        self.alloc_count = np.zeros(self.max_slots, np.int32)
+        self.refcount = np.zeros(self.num_pages, np.int32)
+        self.refcount[0] = 1  # scratch: never allocated, never freed
+        self._free: list[int] = list(range(self.num_pages - 1, 0, -1))
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self._entries: dict[bytes, PrefixEntry] = {}
+        self._children: dict[bytes, dict[tuple, PrefixEntry]] = {}
+        # counters (single-writer on the owning thread; cross-thread
+        # reads are benign monitoring)
+        self.prefix_hits_total = 0
+        self.prefix_hit_tokens_total = 0
+        self.prefix_partial_hits_total = 0
+        self.prefix_lookups_total = 0
+        self.cow_copies_total = 0
+        self.pages_reclaimed_total = 0
+        self.alloc_failures_total = 0
+
+    # ---- pool accounting ----
+
+    def pages_total(self) -> int:
+        return self.num_pages - 1
+
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def pages_used(self) -> int:
+        return self.pages_total() - len(self._free)
+
+    def pages_reclaimable(self) -> int:
+        """Pages held ONLY by the prefix cache (refcount 1 via their
+        entry) — freeable on demand without touching any stream."""
+        return sum(
+            1 for e in self._entries.values()
+            if int(self.refcount[e.page_id]) == 1
+        )
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.page_len)
+
+    # ---- allocation / mapping (lah-gw-decode thread only) ----
+
+    def _pop_free(self) -> int:
+        if not self._free:
+            self.reclaim(1)
+        if not self._free:
+            self.alloc_failures_total += 1
+            raise PagePressure(
+                f"no free KV pages ({self.pages_used()}/"
+                f"{self.pages_total()} in use, 0 reclaimable)"
+            )
+        return self._free.pop()
+
+    def alloc_slot_page(self, slot: int) -> int:
+        """Allocate the slot's NEXT logical page privately."""
+        logical = int(self.alloc_count[slot])
+        if logical >= self.pages_per_slot:
+            raise ValueError(f"slot {slot} already holds every logical page")
+        pid = self._pop_free()
+        self.refcount[pid] = 1
+        self.page_table[slot, logical] = pid
+        self.alloc_count[slot] = logical + 1
+        return pid
+
+    def map_shared(self, slot: int, entry: PrefixEntry) -> int:
+        """Map a prefix-cache page read-only as the slot's next logical
+        page (refcount guards it against writes and reclaim)."""
+        logical = int(self.alloc_count[slot])
+        self.refcount[entry.page_id] += 1
+        self.page_table[slot, logical] = entry.page_id
+        self.alloc_count[slot] = logical + 1
+        entry.last_used = time.monotonic()
+        return entry.page_id
+
+    def release_slot(self, slot: int) -> None:
+        for logical in range(int(self.alloc_count[slot])):
+            self._decref(int(self.page_table[slot, logical]))
+        self.page_table[slot, :] = 0
+        self.alloc_count[slot] = 0
+
+    def _decref(self, pid: int) -> None:
+        if pid == 0:
+            return
+        self.refcount[pid] -= 1
+        if self.refcount[pid] <= 0:
+            self.refcount[pid] = 0
+            self._free.append(pid)
+
+    def reclaim(self, n_pages: int) -> int:
+        """Evict up to ``n_pages`` LRU *leaf* prefix entries whose page
+        nobody maps (refcount 1).  Leaf-first keeps every remaining
+        entry reachable from the chain root; parents become leaves as
+        their children go."""
+        freed = 0
+        while freed < n_pages:
+            leaves = [
+                e for e in self._entries.values()
+                if not self._children.get(e.key)
+                and int(self.refcount[e.page_id]) == 1
+            ]
+            if not leaves:
+                break
+            self._drop_entry(min(leaves, key=lambda e: e.last_used))
+            freed += 1
+        return freed
+
+    def _drop_entry(self, e: PrefixEntry) -> None:
+        del self._entries[e.key]
+        kids = self._children.get(e.parent)
+        if kids is not None:
+            kids.pop(e.tokens, None)
+            if not kids:
+                del self._children[e.parent]
+        self._decref(e.page_id)
+        self.pages_reclaimed_total += 1
+
+    # ---- the prefix index ----
+
+    @staticmethod
+    def _child_key(parent: bytes, tokens: tuple) -> bytes:
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(np.asarray(tokens, np.int64).tobytes())
+        return h.digest()
+
+    def prefix_lookup(self, prompt: Sequence[int]):
+        """(full_entries, partial) for a prompt: the chain of fully
+        matching registered pages, plus at most one boundary page whose
+        content *starts with* the remaining prompt tokens (served
+        copy-on-write by the caller).  The match is capped at
+        ``len(prompt) - 1``: the last prompt token is always prefilled
+        so its logits (the first greedy token) exist."""
+        full: list[PrefixEntry] = []
+        partial: Optional[tuple[PrefixEntry, int]] = None
+        if not self.enable_prefix_cache:
+            return full, partial
+        self.prefix_lookups_total += 1
+        prompt = [int(t) for t in prompt]
+        limit = len(prompt) - 1
+        parent = _ROOT
+        i = 0
+        now = time.monotonic()
+        while i + self.page_len <= limit:
+            kids = self._children.get(parent)
+            e = kids.get(tuple(prompt[i:i + self.page_len])) if kids else None
+            if e is None:
+                break
+            e.last_used = now
+            full.append(e)
+            parent = e.key
+            i += self.page_len
+        r = limit - i
+        if 0 < r < self.page_len:
+            want = tuple(prompt[i:i + r])
+            for toks, e in (self._children.get(parent) or {}).items():
+                if toks[:r] == want:
+                    e.last_used = now
+                    partial = (e, r)
+                    break
+        return full, partial
+
+    def register_prefix(self, slot: int, prompt: Sequence[int]) -> int:
+        """After a prompt's prefill completes, adopt every full prompt
+        page of ``slot`` into the prefix index (pages already mapped
+        from the index are simply walked).  Returns entries added."""
+        if not self.enable_prefix_cache:
+            return 0
+        prompt = [int(t) for t in prompt]
+        parent = _ROOT
+        added = 0
+        now = time.monotonic()
+        for logical in range(len(prompt) // self.page_len):
+            i = logical * self.page_len
+            toks = tuple(prompt[i:i + self.page_len])
+            kids = self._children.setdefault(parent, {})
+            e = kids.get(toks)
+            if e is None:
+                pid = int(self.page_table[slot, logical])
+                if int(self.refcount[pid]) != 1 or pid == 0:
+                    # shared without an entry can only mean the entry
+                    # raced away (reclaim) — do not adopt a page we do
+                    # not exclusively account for
+                    break
+                key = self._child_key(parent, toks)
+                e = PrefixEntry(key, parent, toks, pid, now)
+                kids[toks] = e
+                self._entries[key] = e
+                self.refcount[pid] += 1
+                added += 1
+            parent = e.key
+        if not self._children.get(_ROOT):
+            self._children.pop(_ROOT, None)
+        return added
+
+    # ---- K/V data plane ----
+
+    def copy_page_rows(self, src_pid: int, dst_pid: int, n_rows: int) -> None:
+        """Copy-on-write: clone the first ``n_rows`` K/V rows of a
+        shared page into a private page the caller just allocated."""
+        for layer in range(len(self.k_pools)):
+            self.k_pools[layer] = self.k_pools[layer].at[dst_pid, :n_rows].set(
+                self.k_pools[layer][src_pid, :n_rows]
+            )
+            self.v_pools[layer] = self.v_pools[layer].at[dst_pid, :n_rows].set(
+                self.v_pools[layer][src_pid, :n_rows]
+            )
+        self.cow_copies_total += 1
+
+    def write_tokens(self, layer: int, pids, rows, k, v) -> None:
+        """Scatter K/V rows into (physical page, row) coordinates.
+        Shared pages are immutable — writing one is a refcounting bug,
+        never a race to paper over, so it raises."""
+        pids = np.asarray(pids)
+        bad = (self.refcount[pids] > 1) & (pids != 0)
+        if bad.any():
+            raise AssertionError(
+                f"write to shared KV page(s) {np.unique(pids[bad])} — "
+                "copy-on-write discipline violated"
+            )
+        pids_j = jnp.asarray(pids, jnp.int32)
+        rows_j = jnp.asarray(rows, jnp.int32)
+        self.k_pools[layer] = self.k_pools[layer].at[pids_j, rows_j].set(k)
+        self.v_pools[layer] = self.v_pools[layer].at[pids_j, rows_j].set(v)
+
+    def stats(self) -> dict:
+        return {
+            "kv_layout": "paged",
+            "kv_page_len": self.page_len,
+            "kv_pages_total": self.pages_total(),
+            "kv_pages_used": self.pages_used(),
+            "kv_pages_reclaimable": self.pages_reclaimable(),
+            "prefix_cache": self.enable_prefix_cache,
+            "prefix_entries": len(self._entries),
+            "prefix_hits_total": self.prefix_hits_total,
+            "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
+            "prefix_partial_hits_total": self.prefix_partial_hits_total,
+            "prefix_lookups_total": self.prefix_lookups_total,
+            "cow_copies_total": self.cow_copies_total,
+            "pages_reclaimed_total": self.pages_reclaimed_total,
+            "alloc_failures_total": self.alloc_failures_total,
+        }

@@ -7,19 +7,37 @@ Pod mode decodes inside one jitted scan (models/transformer.py
 mode cannot: every FFN layer is a network fan-out
 (``RemoteMixtureOfExperts.dispatch_async``), so the decode step runs
 EAGERLY on the host — trunk math in jnp, MoE via the pack-once dispatch —
-and the caches live at **static shapes** ``[max_slots, S, H, hd]`` so
-streams can join and leave a running batch (continuous batching) without
-ever recompiling or reallocating:
+and the caches live at **static shapes** so streams can join and leave a
+running batch (continuous batching) without ever recompiling or
+reallocating.  Two KV layouts share every code path above the cache:
 
-- :meth:`prefill_into_slot` runs the full prompt forward for ONE stream
-  and writes its K/V rows into a free slot;
+- ``kv_layout="dense"`` (default): the original ``[max_slots, S, H, hd]``
+  slot table — capacity is burned by the longest POSSIBLE stream;
+- ``kv_layout="paged"``: one ``[num_pages, page_len, H, hd]`` pool per
+  layer with int32 per-slot page tables (models/kv_pages.py) — capacity
+  is bounded by tokens actually in flight, prompts with a shared prefix
+  map already-resident pages read-only instead of recomputing them, and
+  prefill can run in CHUNKS interleaved with decode.  Decode gathers the
+  per-row view through :func:`~learning_at_home_tpu.models.trunk.
+  paged_one_query_attention`, which delegates to the identical masked
+  softmax — paged decode is bitwise-token-equal to dense (tier-1
+  asserted).
+
+Common decode mechanics:
+
+- :meth:`prefill_into_slot` runs a prompt forward for ONE stream and
+  writes its K/V into a free slot; under the paged layout it is just
+  :meth:`begin_prefill` + an unbounded :meth:`prefill_step`, the pair
+  the gateway uses for chunked prefill;
 - :meth:`decode_step` advances EVERY live slot by one token in one
   [max_slots]-row trunk pass — per-slot positions ride through
   :func:`~learning_at_home_tpu.models.trunk.one_query_attention` as a
   ``[B,1,1,1]`` mask bound, so streams at different depths share the
-  batch; dead rows compute garbage that is never read (their slots are
-  re-prefilled before reuse) and are excluded from the MoE fan-out;
-- :meth:`evict` frees a slot immediately (no batch-drain barrier).
+  batch; dead rows compute garbage that is never read (dense: their
+  rows are re-prefilled before reuse; paged: they write into scratch
+  page 0) and are excluded from the MoE fan-out;
+- :meth:`evict` frees a slot immediately (no batch-drain barrier);
+  paged eviction releases the slot's pages back to the pool.
 
 The MoE fan-out goes through a pluggable ``moe_dispatch`` hook: the
 default fires one pack-once dispatch per call; the gateway injects
@@ -28,11 +46,12 @@ streams with overlapping expert sets into shared dispatches.  The hook
 only ever receives LIVE rows, so correctness never depends on it.
 
 Ownership: a decoder instance is single-threaded by contract — the
-gateway's ``lah-gw-decode`` thread owns it exclusively
-(docs/CONCURRENCY.md); tests and generate_lm drive it from one thread.
+gateway's ``lah-gw-decode`` thread owns it (and its page pool)
+exclusively (docs/CONCURRENCY.md invariant 12); tests and generate_lm
+drive it from one thread.
 
 Greedy decoding only (temperature 0): serving determinism is what the
-coalescing bitwise tests and the A/B gate on.
+coalescing bitwise tests, preemption-and-recompute, and the A/B gate on.
 """
 
 from __future__ import annotations
@@ -43,11 +62,13 @@ from typing import Callable, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from learning_at_home_tpu.models.kv_pages import PagedKVCache, PagePressure
 from learning_at_home_tpu.models.trunk import (
     attention_core,
     layer_norm,
     one_query_attention,
     output_projection,
+    paged_one_query_attention,
     qkv_projections,
 )
 
@@ -57,13 +78,12 @@ logger = logging.getLogger(__name__)
 def default_moe_dispatch(layer, moe, gate_params, x_rows, row_streams):
     """One pack-once dispatch for all rows of one decode/prefill call —
     gate in jnp (differentiability is irrelevant here, but the math must
-    match training's :meth:`RemoteMixtureOfExperts.__call__` exactly),
-    fire, join, combine.  ``row_streams`` is unused: this is the
-    ungrouped baseline the coalescer is benched and tested against."""
+    match training's :meth:`RemoteMixtureOfExperts.__call__` exactly,
+    hence the shared ``gate_logits``), fire, join, combine.
+    ``row_streams`` is unused: this is the ungrouped baseline the
+    coalescer is benched and tested against."""
     x_rows = jnp.asarray(x_rows)
-    logits_concat = jnp.concatenate(
-        [x_rows @ gate_params[f"w{d}"] for d in range(moe.n_dims)], axis=-1
-    )
+    logits_concat = moe.gate_logits(gate_params, x_rows)
     fut = moe.dispatch_async(
         np.asarray(x_rows), np.asarray(logits_concat), store_session=False
     )
@@ -77,7 +97,7 @@ class SwarmKVDecoder:
     ``max_slots`` concurrent streams, each up to ``seq_len`` total
     positions (prompt + generated).  All arrays are allocated once at
     construction; stream churn mutates per-slot scalars and overwrites
-    cache rows in place.
+    cache rows (dense) or remaps page tables (paged) in place.
     """
 
     def __init__(
@@ -88,10 +108,18 @@ class SwarmKVDecoder:
         max_slots: int = 8,
         max_seq_len: Optional[int] = None,
         moe_dispatch: Optional[Callable] = None,
+        kv_layout: str = "dense",
+        page_len: int = 16,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ):
         cfg = model.cfg
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
+            )
         self.model = model
         self.params = params
         self.max_slots = int(max_slots)
@@ -102,29 +130,70 @@ class SwarmKVDecoder:
                 f"table ({cfg.seq_len})"
             )
         hd = cfg.d_model // cfg.n_heads
-        shape = (self.max_slots, self.seq_len, cfg.n_heads, hd)
-        self.k_caches = [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)]
-        self.v_caches = [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)]
+        self.kv_layout = kv_layout
+        if kv_layout == "paged":
+            self.kv: Optional[PagedKVCache] = PagedKVCache(
+                n_layers=cfg.n_layers,
+                n_heads=cfg.n_heads,
+                head_dim=hd,
+                dtype=cfg.dtype,
+                max_slots=self.max_slots,
+                seq_len=self.seq_len,
+                page_len=page_len,
+                num_pages=num_pages,
+                enable_prefix_cache=prefix_cache,
+            )
+            self.k_caches = self.v_caches = None
+        else:
+            self.kv = None
+            shape = (self.max_slots, self.seq_len, cfg.n_heads, hd)
+            self.k_caches = [
+                jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)
+            ]
+            self.v_caches = [
+                jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)
+            ]
         # per-slot scalars (host side — only the owning thread touches them)
         self.pos = np.zeros(self.max_slots, np.int32)  # cached positions == t
         self.last_tok = np.zeros(self.max_slots, np.int32)
         self.live = np.zeros(self.max_slots, bool)
+        # mid-prefill slots (paged chunked prefill only): hold pages and a
+        # slot but are not yet decodable
+        self.prefilling = np.zeros(self.max_slots, bool)
+        self._prefill_prompt: list = [None] * self.max_slots
         self.stream_ids: list = [None] * self.max_slots
         self._moe_dispatch = moe_dispatch or default_moe_dispatch
         self.prefills_total = 0
+        self.prefill_chunks_total = 0
         self.decode_steps_total = 0
 
     # ---- slot bookkeeping ----
 
+    @property
+    def supports_chunked_prefill(self) -> bool:
+        return self.kv is not None
+
     def free_slots(self) -> list[int]:
-        return [i for i in range(self.max_slots) if not self.live[i]]
+        return [
+            i for i in range(self.max_slots)
+            if not self.live[i] and not self.prefilling[i]
+        ]
 
     def live_slots(self) -> list[tuple[int, object]]:
-        """(slot, stream_id) for every occupied slot, slot order."""
+        """(slot, stream_id) for every DECODING slot, slot order
+        (mid-prefill slots are not yet decodable)."""
         return [
             (i, self.stream_ids[i])
             for i in range(self.max_slots)
             if self.live[i]
+        ]
+
+    def prefilling_slots(self) -> list[tuple[int, object]]:
+        """(slot, stream_id) for every mid-prefill slot, slot order."""
+        return [
+            (i, self.stream_ids[i])
+            for i in range(self.max_slots)
+            if self.prefilling[i]
         ]
 
     def at_capacity(self, slot: int) -> bool:
@@ -132,20 +201,50 @@ class SwarmKVDecoder:
         return int(self.pos[slot]) >= self.seq_len
 
     def evict(self, slot: int) -> None:
-        """Free a slot immediately.  Cache rows are NOT zeroed: the next
-        prefill overwrites positions [0, p) and every decode step's
-        attention masks positions > t, so stale rows are unreachable."""
+        """Free a slot immediately (decoding OR mid-prefill).  Cache
+        content is NOT zeroed: dense rows are overwritten by the next
+        prefill and masked until then; paged pages go back to the free
+        list (or stay resident for the prefix cache if registered)."""
         self.live[slot] = False
+        self.prefilling[slot] = False
+        self._prefill_prompt[slot] = None
         self.stream_ids[slot] = None
+        self.pos[slot] = 0
+        if self.kv is not None:
+            self.kv.release_slot(slot)
+
+    # ---- paged capacity surface (read by scheduler/admission) ----
+
+    def pages_needed(self, prompt_len: int, max_new_tokens: int = 0) -> int:
+        """Physical pages a stream of this shape will occupy at peak
+        (0 under the dense layout — admission falls back to slots)."""
+        if self.kv is None:
+            return 0
+        total = min(int(prompt_len) + int(max_new_tokens), self.seq_len)
+        return self.kv.pages_needed(total)
+
+    def free_page_headroom(self) -> Optional[int]:
+        """Free + reclaimable pages minus one-per-active-slot reserve
+        (every live/prefilling stream may need one more page within a
+        step).  None under the dense layout.  Read cross-thread by
+        admission — plain-int reads, the same benign monitoring race as
+        the live mask."""
+        if self.kv is None:
+            return None
+        active = int((self.live | self.prefilling).sum())
+        return (
+            self.kv.pages_free() + self.kv.pages_reclaimable() - active
+        )
+
+    def kv_stats(self) -> dict:
+        if self.kv is None:
+            return {"kv_layout": "dense"}
+        return self.kv.stats()
 
     # ---- prefill: one stream's prompt forward into a free slot ----
 
-    def prefill_into_slot(self, slot: int, prompt_ids, stream_id=None) -> int:
-        """Full forward over one prompt; K/V written into ``slot``;
-        returns the first greedy token.  The trunk math is exactly
-        ``SwarmDMoETransformerLM.apply`` (trunk.py helpers), so a decoder
-        parity test against a re-forward holds to numerical noise."""
-        if self.live[slot]:
+    def _check_prompt(self, slot: int, prompt_ids) -> np.ndarray:
+        if self.live[slot] or self.prefilling[slot]:
             raise ValueError(f"slot {slot} is occupied")
         prompt = np.asarray(prompt_ids, np.int32)
         p = int(prompt.shape[0])
@@ -154,6 +253,23 @@ class SwarmKVDecoder:
                 f"prompt length {p} must be in [1, {self.seq_len - 1}] "
                 "(one free position is needed to decode)"
             )
+        return prompt
+
+    def prefill_into_slot(self, slot: int, prompt_ids, stream_id=None) -> int:
+        """Full forward over one prompt; K/V written into ``slot``;
+        returns the first greedy token.  The trunk math is exactly
+        ``SwarmDMoETransformerLM.apply`` (trunk.py helpers), so a decoder
+        parity test against a re-forward holds to numerical noise.
+        Paged layout: one unbounded chunk through the chunked-prefill
+        path (and the prefix cache still applies)."""
+        if self.kv is not None:
+            self.begin_prefill(slot, prompt_ids, stream_id=stream_id)
+            tok = None
+            while tok is None:
+                _consumed, tok = self.prefill_step(slot, self.seq_len)
+            return tok
+        prompt = self._check_prompt(slot, prompt_ids)
+        p = int(prompt.shape[0])
         cfg = self.model.cfg
         params = self.params
         x = params["embed"][jnp.asarray(prompt)][None] + params["pos"][None, :p]
@@ -178,13 +294,136 @@ class SwarmKVDecoder:
         self.prefills_total += 1
         return tok
 
+    def begin_prefill(self, slot: int, prompt_ids, stream_id=None) -> int:
+        """Claim ``slot`` for a prompt under the paged layout and serve
+        whatever the prefix cache already holds: fully matching pages
+        are mapped read-only into the slot's page table, a partial match
+        on the boundary page is copied into a fresh private page
+        (copy-on-write — shared pages are never written).  Returns the
+        number of prompt tokens whose prefill is skipped; the rest is
+        computed by :meth:`prefill_step` calls.  Raises
+        :class:`PagePressure` (slot left clean) if the boundary copy
+        cannot get a page."""
+        if self.kv is None:
+            raise ValueError("begin_prefill requires kv_layout='paged'")
+        prompt = self._check_prompt(slot, prompt_ids)
+        prompt_list = [int(t) for t in prompt]
+        full, partial = self.kv.prefix_lookup(prompt_list)
+        matched = 0
+        try:
+            for e in full:
+                self.kv.map_shared(slot, e)
+            matched = len(full) * self.kv.page_len
+            if partial is not None:
+                e, r = partial
+                dst = self.kv.alloc_slot_page(slot)
+                self.kv.copy_page_rows(e.page_id, dst, r)
+                matched += r
+                self.kv.prefix_partial_hits_total += 1
+        except PagePressure:
+            self.kv.release_slot(slot)
+            raise
+        if matched:
+            self.kv.prefix_hits_total += 1
+            self.kv.prefix_hit_tokens_total += matched
+        self.prefilling[slot] = True
+        self._prefill_prompt[slot] = prompt_list
+        self.pos[slot] = matched
+        self.stream_ids[slot] = stream_id
+        return matched
+
+    def prefill_step(self, slot: int, max_tokens: int):
+        """Advance ``slot``'s prefill by up to ``max_tokens`` prompt
+        tokens in ONE trunk pass (multi-query attention over the paged
+        cache; K/V are written before the gather so within-chunk
+        causality holds).  Returns ``(consumed, first_token_or_None)``
+        — the token is produced when the prompt completes, at which
+        point the slot turns live and its full pages are registered in
+        the prefix cache.  Raises :class:`PagePressure` if the chunk
+        needs a page the pool cannot supply; already-written pages stay
+        mapped, so the call is retryable (or the scheduler preempts)."""
+        if self.kv is None:
+            raise ValueError("prefill_step requires kv_layout='paged'")
+        if not self.prefilling[slot]:
+            raise ValueError(f"slot {slot} is not mid-prefill")
+        if max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        prompt = self._prefill_prompt[slot]
+        p = len(prompt)
+        start = int(self.pos[slot])
+        c = min(int(max_tokens), p - start)
+        pages = self.kv.pages_needed(start + c)
+        while int(self.kv.alloc_count[slot]) < pages:
+            self.kv.alloc_slot_page(slot)  # may raise PagePressure
+        cfg = self.model.cfg
+        params = self.params
+        chunk = prompt[start:start + c]
+        positions = np.arange(start, start + c, dtype=np.int32)
+        pids = self.kv.page_table[slot, positions // self.kv.page_len]
+        rows = positions % self.kv.page_len
+        pt_row = jnp.asarray(self.kv.page_table[slot:slot + 1])
+        t_q = jnp.asarray(positions)[None, None, :, None]  # [1,1,C,1]
+        x = (
+            params["embed"][jnp.asarray(np.asarray(chunk, np.int32))][None]
+            + params["pos"][None, start:start + c]
+        )
+        sid = self.stream_ids[slot]
+        for i, lp in enumerate(params["layers"]):
+            h = layer_norm(lp["ln1"], x)
+            q, k, v = qkv_projections(lp, h, cfg.n_heads)
+            self.kv.write_tokens(i, pids, rows, k[0], v[0])
+            x = x + paged_one_query_attention(
+                lp, q, self.kv.k_pools[i], self.kv.v_pools[i], pt_row, t_q
+            )
+            moe_in = layer_norm(lp["ln2"], x).reshape(c, cfg.d_model)
+            y = self._moe_dispatch(
+                i, self.model.moes[i], lp["gate"], moe_in, [sid] * c
+            )
+            x = x + jnp.asarray(y).reshape(1, c, cfg.d_model).astype(x.dtype)
+        self.pos[slot] = start + c
+        self.prefill_chunks_total += 1
+        if start + c < p:
+            return c, None
+        x_last = layer_norm(params["ln_f"], x[:, -1])
+        logits = x_last @ params["embed"].T
+        tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        self.kv.register_prefix(slot, prompt)
+        self.last_tok[slot] = tok
+        self.live[slot] = True
+        self.prefilling[slot] = False
+        self._prefill_prompt[slot] = None
+        self.prefills_total += 1
+        return c, tok
+
+    def ensure_decode_pages(self) -> list[int]:
+        """Map a physical page for every live slot's next decode
+        position; returns the slots that could NOT get one after
+        reclaim (page pressure) — the scheduler preempts those before
+        calling :meth:`decode_step`.  No-op under the dense layout."""
+        if self.kv is None:
+            return []
+        lacking = []
+        for s in np.nonzero(self.live)[0]:
+            s = int(s)
+            if self.at_capacity(s):
+                continue
+            logical = int(self.pos[s]) // self.kv.page_len
+            while int(self.kv.alloc_count[s]) <= logical:
+                try:
+                    self.kv.alloc_slot_page(s)
+                except PagePressure:
+                    lacking.append(s)
+                    break
+        return lacking
+
     # ---- decode: one token for every live slot in one batch ----
 
     def decode_step(self) -> np.ndarray:
         """Advance every live slot by one token.  Returns the [max_slots]
         int32 next-token array — entries at dead slots are garbage.  The
         trunk runs at the static [max_slots] batch (dead rows compute on
-        position-0 garbage, never read); the MoE fan-out sees only the
+        position-0 garbage, never read; under the paged layout their
+        writes land in scratch page 0); the MoE fan-out sees only the
         live rows."""
         live_rows = np.nonzero(self.live)[0]
         if live_rows.size == 0:
@@ -196,6 +435,24 @@ class SwarmKVDecoder:
         b = self.max_slots
         t = np.where(self.live, self.pos, 0).astype(np.int32)
         t_j = jnp.asarray(t)
+        if self.kv is not None:
+            logical = np.minimum(
+                t // self.kv.page_len, self.kv.pages_per_slot - 1
+            )
+            if (self.live & (self.kv.alloc_count <= logical)).any():
+                raise ValueError(
+                    "a live slot has no KV page for its decode position — "
+                    "call ensure_decode_pages() first"
+                )
+            pids = np.where(
+                self.live,
+                self.kv.page_table[np.arange(b), logical],
+                0,
+            ).astype(np.int32)
+            rows = np.where(self.live, t % self.kv.page_len, 0).astype(
+                np.int32
+            )
+            pt = jnp.asarray(self.kv.page_table)
         rows_idx = jnp.arange(b)
         x = params["embed"][jnp.asarray(self.last_tok)] + params["pos"][t_j]
         x = x[:, None, :]  # [B, 1, d]
@@ -203,12 +460,23 @@ class SwarmKVDecoder:
         for i, lp in enumerate(params["layers"]):
             h = layer_norm(lp["ln1"], x)
             q, k, v = qkv_projections(lp, h, cfg.n_heads)
-            self.k_caches[i] = self.k_caches[i].at[rows_idx, t_j].set(k[:, 0])
-            self.v_caches[i] = self.v_caches[i].at[rows_idx, t_j].set(v[:, 0])
-            x = x + one_query_attention(
-                lp, q, self.k_caches[i], self.v_caches[i],
-                t_j[:, None, None, None],
-            )
+            if self.kv is not None:
+                self.kv.write_tokens(i, pids, rows, k[:, 0], v[:, 0])
+                x = x + paged_one_query_attention(
+                    lp, q, self.kv.k_pools[i], self.kv.v_pools[i], pt,
+                    t_j[:, None, None, None],
+                )
+            else:
+                self.k_caches[i] = (
+                    self.k_caches[i].at[rows_idx, t_j].set(k[:, 0])
+                )
+                self.v_caches[i] = (
+                    self.v_caches[i].at[rows_idx, t_j].set(v[:, 0])
+                )
+                x = x + one_query_attention(
+                    lp, q, self.k_caches[i], self.v_caches[i],
+                    t_j[:, None, None, None],
+                )
             moe_in = layer_norm(lp["ln2"], x).reshape(b, cfg.d_model)
             y_rows = self._moe_dispatch(
                 i, self.model.moes[i], lp["gate"], moe_in[live_j],
@@ -252,6 +520,12 @@ class SwarmKVDecoder:
             active = [s for s in slots if self.live[s]]
             if not active:
                 break
+            lacking = self.ensure_decode_pages()
+            if lacking:
+                raise PagePressure(
+                    f"slots {lacking} cannot get a decode page — the pool "
+                    "is undersized for this closed-loop batch"
+                )
             nxt = self.decode_step()
             for sid, slot in enumerate(slots):
                 if self.live[slot]:

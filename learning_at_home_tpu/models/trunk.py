@@ -96,11 +96,13 @@ def one_query_attention(
     ``jax.nn.dot_product_attention`` in the full forward.
 
     ``t`` is either a scalar (pod decode: every row sits at the same
-    position) or anything broadcastable against the [B,1,1,S] score mask
+    position) or anything broadcastable against the [B,H,Q,S] score mask
     — the swarm KV decoder (models/swarm_decoder.py) passes [B,1,1,1]
     per-slot positions so one continuous batch can hold streams at
-    different depths.  Shared here so the pod decoder and the gateway's
-    swarm decoder cannot drift numerically.
+    different depths, and its chunked prefill passes Q > 1 queries with
+    [1,1,Q,1] per-query positions (the einsums generalize over Q
+    untouched).  Shared here so the pod decoder and the gateway's swarm
+    decoder cannot drift numerically.
     """
     hd = q.shape[-1]
     scores = jnp.einsum(
@@ -112,3 +114,40 @@ def one_query_attention(
     w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqs,bshd->bqhd", w, v_cache)
     return output_projection(lp, out)
+
+
+def gather_kv_pages(
+    pool: jax.Array, page_tables: jax.Array
+) -> jax.Array:
+    """[num_pages,P,H,hd] pool + [B,n] int32 page tables → a [B,n*P,H,hd]
+    contiguous per-row KV view.  A static-shape gather — jit-friendly
+    int32 indirection, no data-dependent shapes.  Unmapped table entries
+    point at scratch page 0; its (finite) garbage sits at positions the
+    caller's ``t`` mask excludes, so the softmax sees weight exactly 0
+    there and the output is bitwise what a dense cache would produce.
+    """
+    b, n = page_tables.shape
+    num_pages, page_len, h, hd = pool.shape
+    return pool[page_tables].reshape(b, n * page_len, h, hd)
+
+
+def paged_one_query_attention(
+    lp: dict,
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_tables: jax.Array,
+    t,
+) -> jax.Array:
+    """:func:`one_query_attention` over a PAGED KV cache: per-row caches
+    are materialized from the shared page pool via int32 page-table
+    gathers, then the identical masked-softmax core runs on the view —
+    paged decode is bitwise-equal to dense decode by construction (the
+    tier-1 parity contract).  A fused TPU kernel (Pallas paged_attention,
+    /opt/skills/guides/boom_attention_tricks.md §8) would stream pages
+    without materializing the view; this path keeps the same [pages,
+    page table] layout so that swap stays a kernel substitution.
+    """
+    k = gather_kv_pages(k_pool, page_tables)
+    v = gather_kv_pages(v_pool, page_tables)
+    return one_query_attention(lp, q, k, v, t)

@@ -195,6 +195,26 @@ def test_gateway_rpc_end_to_end(swarm):
             client.submit([VOCAB + 7], 5)
         with pytest.raises(RemoteCallError):
             client.submit([1] * SEQ, 5)  # no cache room left to decode
+        # over-long prompts are a well-formed error frame, never a
+        # crash, a silent truncation, or a wedged pending queue
+        with pytest.raises(RemoteCallError):
+            client.submit([1] * (SEQ + 1), 5)
+        with pytest.raises(RemoteCallError):
+            client.submit([1] * (SEQ * 4), 5)
+        # bools are not token ids nor a token budget — raw frames here,
+        # since GatewayClient.submit int-coerces its arguments
+        with pytest.raises(RemoteCallError):
+            client._rpc(
+                "gen_submit", {"prompt": [True, False], "max_new_tokens": 5}
+            )
+        with pytest.raises(RemoteCallError):
+            client._rpc(
+                "gen_submit", {"prompt": [1, 2], "max_new_tokens": True}
+            )
+        # the gateway survived the whole battery: still serving
+        out = client.generate([1, 2, 3], 5)
+        assert not out.get("shed") and not out.get("error")
+        assert out["tokens"] == ref
 
 
 def test_saturated_gateway_sheds_not_queues(swarm):
@@ -210,7 +230,12 @@ def test_saturated_gateway_sheds_not_queues(swarm):
         for r in shed:
             assert r["accepted"] is False
             assert r["retry_after_s"] > 0
-            assert "saturated" in r["message"]
+            # either signal is a legitimate shed on a 1-slot gateway:
+            # pending-bound saturation or KV page pressure
+            assert (
+                "saturated" in r["message"]
+                or "page pressure" in r["message"]
+            )
         # bounded: at no point can more than max_pending streams wait
         assert gw.scheduler.pending_count() <= 2
         assert gw.admission.shed_total == len(shed)
@@ -316,22 +341,36 @@ def test_lah_top_renders_gateway_columns():
 
     rows = [
         row("gw-1", {"streams_active": 3, "streams_total": 41,
-                     "slots": 8, "slots_in_use": 2, "shed_total": 7}),
+                     "slots": 8, "slots_in_use": 2, "shed_total": 7,
+                     "kv_pages_total": 33, "kv_pages_used": 12,
+                     "prefix_hits_total": 5}),
+        # dense-layout gateway: slot columns fill, page columns dash
+        row("gw-dense", {"streams_active": 1, "streams_total": 2,
+                         "slots": 4, "slots_in_use": 1, "shed_total": 0}),
         {"peer_id": "srv-1", "role": "server",
          "endpoint": ("127.0.0.1", 2), "expires_at": 0.0, "snapshot": {}},
     ]
     out = lah_top.render(rows, "swarm", dead=set())
     assert "STREAMS" in out and "SLOTS" in out and "SHED" in out
-    assert "3/41" in out and "2/8" in out
+    assert "PAGES" in out and "PFX-HIT" in out
+    assert "3/41" in out and "2/8" in out and "12/33" in out
     gw_line = next(ln for ln in out.splitlines() if ln.startswith("gw-1"))
-    assert gw_line.rstrip().endswith("7")
+    assert gw_line.rstrip().endswith("5")  # PFX-HIT is the last column
+    assert " 12/33 " in gw_line
+    dense_line = next(
+        ln for ln in out.splitlines() if ln.startswith("gw-dense")
+    )
+    assert dense_line.rstrip().endswith("-")  # no page pool to report
+    assert " 1/4 " in dense_line
     # peers without a gateway section render dashes
     srv_line = next(ln for ln in out.splitlines() if ln.startswith("srv-1"))
     assert srv_line.rstrip().endswith("-")
     # malformed sections render dashes, never crash
     rows.append(row("gw-weird", {"slots": "eight", "shed_total": 1}))
     rows.append(row("gw-bool", {"slots": True}))
+    rows.append(row("gw-badpages", {"slots": 2, "kv_pages_total": "many",
+                                    "prefix_hits_total": 3}))
     out = lah_top.render(rows, "swarm", dead=set())
-    for peer in ("gw-weird", "gw-bool"):
+    for peer in ("gw-weird", "gw-bool", "gw-badpages"):
         line = next(ln for ln in out.splitlines() if ln.startswith(peer))
         assert line.rstrip().endswith("-")

@@ -1,0 +1,431 @@
+"""Paged KV cache + shared-prefix reuse + chunked prefill (ISSUE 13).
+
+The contracts under test:
+
+- **bitwise token parity**: the paged decoder (page-table gathers into
+  the same masked-softmax core) emits token streams identical to the
+  dense slot-table decoder on the same prompts against the same servers,
+  for aligned and unaligned page sizes, and regardless of the prefill
+  chunk size;
+- **prefix cache**: a prompt whose prefix is already resident maps the
+  shared pages read-only and skips prefill compute for those tokens,
+  with output identical to a cold decode; the boundary page is served
+  copy-on-write — the writer gets a private page and the shared source
+  page provably never changes;
+- **page pressure**: exhaustion sheds with a well-formed
+  ``retry_after_s`` busy frame (0 error frames), preemption requeues
+  recompute token-identical continuations, and a prompt that cannot
+  EVER fit the pool errors at submit instead of wedging the queue;
+- pure pool/refcount/reclaim bookkeeping (no swarm needed).
+"""
+
+import contextlib
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_at_home_tpu.client import reset_client_rpc
+from learning_at_home_tpu.client.routing import StaticExpertSource
+from learning_at_home_tpu.gateway import Gateway, GatewayClient
+from learning_at_home_tpu.models.kv_pages import PagedKVCache, PagePressure
+from learning_at_home_tpu.models.swarm_decoder import SwarmKVDecoder
+from learning_at_home_tpu.models.transformer_swarm import (
+    SwarmDMoETransformerLM,
+    SwarmTransformerConfig,
+)
+from learning_at_home_tpu.server.server import background_server
+
+D = 16
+VOCAB = 32
+SEQ = 16
+LAYERS = 2
+UIDS = [f"ffn{layer}.{e}" for layer in range(LAYERS) for e in range(2)]
+
+
+def _cfg(**overrides):
+    base = dict(
+        vocab_size=VOCAB, d_model=D, n_layers=LAYERS, n_heads=4,
+        seq_len=SEQ, grid_size=(2,), k_best=2, k_min=2, uid_prefix="ffn",
+        timeout_after_k_min=30.0,
+        forward_timeout=60.0, backward_timeout=60.0,
+        wire_codec="none", routing_cost_weight=0,
+    )
+    base.update(overrides)
+    return SwarmTransformerConfig(**base)
+
+
+@pytest.fixture()
+def swarm():
+    """One in-process server hosting all experts + a swarm model."""
+    with contextlib.ExitStack() as stack:
+        endpoint, _srv = stack.enter_context(
+            background_server(expert_uids=UIDS, hidden_dim=D, seed=0)
+        )
+        src = StaticExpertSource({u: endpoint for u in UIDS})
+        model = SwarmDMoETransformerLM(_cfg(), src)
+        params = model.init_params(jax.random.PRNGKey(0))
+        yield model, params
+    reset_client_rpc()
+
+
+# ---------------------------------------------------------------------------
+# pure pool bookkeeping (no swarm)
+# ---------------------------------------------------------------------------
+
+
+def _pool(**overrides):
+    kw = dict(
+        n_layers=1, n_heads=2, head_dim=4, dtype=jnp.float32,
+        max_slots=2, seq_len=12, page_len=4, num_pages=5,
+    )
+    kw.update(overrides)
+    return PagedKVCache(**kw)
+
+
+def test_pool_alloc_release_refcounts():
+    kv = _pool()
+    assert kv.pages_total() == 4 and kv.pages_used() == 0
+    p0 = kv.alloc_slot_page(0)
+    p1 = kv.alloc_slot_page(0)
+    assert p0 != 0 and p1 != 0 and p0 != p1
+    assert kv.pages_used() == 2
+    assert list(kv.page_table[0, :2]) == [p0, p1]
+    kv.release_slot(0)
+    assert kv.pages_used() == 0
+    assert kv.refcount[p0] == 0 and kv.refcount[p1] == 0
+    # scratch page 0 is never handed out even under churn
+    for _ in range(3):
+        pids = [kv.alloc_slot_page(1) for _ in range(3)]
+        assert 0 not in pids
+        kv.release_slot(1)
+
+
+def test_pool_exhaustion_raises_page_pressure():
+    kv = _pool(num_pages=3)  # 2 usable
+    kv.alloc_slot_page(0)
+    kv.alloc_slot_page(0)
+    with pytest.raises(PagePressure):
+        kv.alloc_slot_page(1)
+    assert kv.alloc_failures_total == 1
+    kv.release_slot(0)
+    assert kv.alloc_slot_page(1) != 0  # recovers after release
+
+
+def test_prefix_register_lookup_and_leaf_reclaim():
+    kv = _pool(num_pages=8, max_slots=3)
+    # simulate a finished 8-token prefill in slot 0: two full pages
+    for _ in range(2):
+        kv.alloc_slot_page(0)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    assert kv.register_prefix(0, prompt) == 2
+    # an identical prompt matches one full page (the cap at p-1 keeps
+    # the final token's prefill, so the second page only part-matches)
+    full, partial = kv.prefix_lookup(prompt)
+    assert len(full) == 1 and partial is not None
+    entry, r = partial
+    assert r == 3 and entry.tokens == (5, 6, 7, 8)
+    # a longer prompt sharing both pages matches both in the chain
+    full, partial = kv.prefix_lookup(prompt + [9, 10])
+    assert len(full) == 2 and partial is None
+    # a diverging prompt stops the chain at the divergence
+    full, partial = kv.prefix_lookup([1, 2, 3, 4, 9, 9, 9, 9, 9])
+    assert len(full) == 1 and partial is None
+    # release the writer: pages now held only by the cache → reclaimable,
+    # and reclaim drops the LEAF (second page) before the parent
+    kv.release_slot(0)
+    assert kv.pages_reclaimable() == 2
+    leaf_pid = next(
+        e.page_id for e in kv._entries.values() if e.tokens == (5, 6, 7, 8)
+    )
+    assert kv.reclaim(1) == 1
+    assert kv.refcount[leaf_pid] == 0
+    assert kv.pages_reclaimed_total == 1
+    full, _partial = kv.prefix_lookup(prompt + [9, 10])
+    assert len(full) == 1  # parent survives, chain just shortens
+
+
+def test_shared_page_write_guard():
+    kv = _pool(num_pages=6)
+    kv.alloc_slot_page(0)
+    assert kv.register_prefix(0, [1, 2, 3, 4]) == 1
+    pid = int(kv.page_table[0, 0])
+    assert kv.refcount[pid] == 2  # slot + cache entry
+    k = jnp.zeros((1, 2, 4))
+    with pytest.raises(AssertionError, match="copy-on-write"):
+        kv.write_tokens(0, np.array([pid]), np.array([0]), k, k)
+    # scratch-page writes (dead decode rows) stay allowed
+    kv.write_tokens(0, np.array([0]), np.array([0]), k, k)
+
+
+# ---------------------------------------------------------------------------
+# bitwise token parity: paged == dense, any page size, any chunk size
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("page_len", [4, 5, 16])
+def test_paged_vs_dense_bitwise_token_parity(swarm, page_len):
+    """Same prompts, same servers: the paged decoder's greedy tokens are
+    IDENTICAL to the dense slot table's — the gather through the page
+    table feeds the same masked-softmax core (trunk.py), so parity is
+    bitwise, not approximate."""
+    model, params = swarm
+    prompts = [[1, 2, 3], [4, 5], [7, 8, 9, 10, 11]]
+    dense = SwarmKVDecoder(model, params, max_slots=3)
+    paged = SwarmKVDecoder(
+        model, params, max_slots=3, kv_layout="paged", page_len=page_len
+    )
+    out_d = dense.generate(prompts, max_new_tokens=6)
+    out_p = paged.generate(prompts, max_new_tokens=6)
+    assert out_d == out_p
+    # every page went back to the pool
+    assert paged.kv.pages_used() - paged.kv.pages_reclaimable() <= 0
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 64])
+def test_chunked_prefill_token_equal_any_chunk_size(swarm, chunk):
+    """Prefill in chunks of 1, 3 or all-at-once: identical first token
+    and identical decode continuation — chunking is a scheduling choice,
+    never a numerics choice."""
+    model, params = swarm
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    ref = SwarmKVDecoder(model, params, max_slots=1).generate(
+        [prompt], max_new_tokens=4
+    )[0]
+    dec = SwarmKVDecoder(
+        model, params, max_slots=1, kv_layout="paged", page_len=4,
+        prefix_cache=False,
+    )
+    assert dec.begin_prefill(0, prompt, stream_id="s") == 0
+    toks = []
+    tok = None
+    while tok is None:
+        consumed, tok = dec.prefill_step(0, chunk)
+        assert consumed <= chunk
+    toks.append(tok)
+    while len(toks) < 4:
+        assert dec.ensure_decode_pages() == []
+        toks.append(int(dec.decode_step()[0]))
+    assert toks == ref
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: hits skip prefill; boundary COW never aliases a writer
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_skips_prefill_tokens_and_matches_cold(swarm):
+    model, params = swarm
+    A = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]  # 3 full pages @ page_len 4
+    B = A[:10] + [7]  # shares 10 tokens, then diverges
+    warm = SwarmKVDecoder(
+        model, params, max_slots=3, kv_layout="paged", page_len=4
+    )
+    cold = SwarmKVDecoder(
+        model, params, max_slots=1, kv_layout="paged", page_len=4,
+        prefix_cache=False,
+    )
+    warm.prefill_into_slot(0, A, stream_id="a")
+    skipped = warm.begin_prefill(1, B, stream_id="b")
+    # 2 full shared pages + a 2-token COW boundary match
+    assert skipped == 10
+    assert warm.kv.prefix_hits_total == 1
+    assert warm.kv.prefix_hit_tokens_total == 10
+    assert warm.kv.cow_copies_total == 1
+    consumed_total = 0
+    tok = None
+    while tok is None:
+        consumed, tok = warm.prefill_step(1, SEQ)
+        consumed_total += consumed
+    # the hit SKIPPED compute: only the unmatched tail went through the
+    # trunk/MoE
+    assert consumed_total == len(B) - skipped == 1
+    assert tok == cold.prefill_into_slot(0, B, stream_id="cold")
+    # decode continuations stay identical too
+    for _ in range(3):
+        assert warm.ensure_decode_pages() == []
+        assert cold.ensure_decode_pages() == []
+        assert int(warm.decode_step()[1]) == int(cold.decode_step()[0])
+
+
+def test_boundary_cow_never_aliases_writer(swarm):
+    """The COW boundary page is a PRIVATE copy: the reader stream writes
+    its divergent tail + decode tokens there while the shared source
+    page stays bit-identical, and the original stream keeps decoding
+    from unchanged pages."""
+    model, params = swarm
+    A = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    B = A[:10] + [7]
+    dec = SwarmKVDecoder(
+        model, params, max_slots=2, kv_layout="paged", page_len=4
+    )
+    dec.prefill_into_slot(0, A, stream_id="a")
+    src_pid = int(dec.kv.page_table[0, 2])  # A's third full page
+    src_k = [np.asarray(kp[src_pid]) for kp in dec.kv.k_pools]
+    src_v = [np.asarray(vp[src_pid]) for vp in dec.kv.v_pools]
+    dec.begin_prefill(1, B, stream_id="b")
+    cow_pid = int(dec.kv.page_table[1, 2])
+    assert cow_pid != src_pid, "boundary page must be a private copy"
+    assert int(dec.kv.refcount[cow_pid]) == 1
+    tok = None
+    while tok is None:
+        _c, tok = dec.prefill_step(1, SEQ)
+    # B decodes several tokens — all its writes land in private pages
+    for _ in range(3):
+        assert dec.ensure_decode_pages() == []
+        dec.decode_step()
+    for i in range(LAYERS):
+        assert np.array_equal(np.asarray(dec.kv.k_pools[i][src_pid]),
+                              src_k[i])
+        assert np.array_equal(np.asarray(dec.kv.v_pools[i][src_pid]),
+                              src_v[i])
+    assert dec.kv.cow_copies_total == 1
+
+
+# ---------------------------------------------------------------------------
+# page pressure end-to-end: sheds carry retry_after_s, zero error frames
+# ---------------------------------------------------------------------------
+
+
+def test_page_exhaustion_sheds_with_retry_after_zero_errors(swarm):
+    """While an occupant stream holds the whole 2-usable-page pool, a
+    new stream's 2-page need exceeds headroom → a well-formed busy frame
+    with ``retry_after_s`` — never an error frame — and service resumes
+    once the occupant drains."""
+    model, params = swarm
+    with Gateway(
+        model, params, max_slots=4, max_pending=64,
+        page_len=8, num_pages=3,  # 2 usable pages — one stream's worth
+        prefix_cache=False,
+    ) as gw:
+        client = GatewayClient(gw.endpoint)
+        shed = None
+        occupants = []
+        for _attempt in range(5):
+            sub = client.submit([1, 2, 3, 4], 12)
+            if not sub.get("accepted"):
+                shed = sub  # a previous occupant still holds the pool
+                break
+            occupants.append(sub["sid"])
+            # wait until the occupant is live (headroom now < 2) …
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if client.poll(sub["sid"], 0).get("tokens"):
+                    break
+                time.sleep(0.005)
+            # … then probe; if the occupant drained first, go again
+            probe = client.submit([5, 6, 7, 8], 8)
+            if probe.get("shed"):
+                shed = probe
+                break
+            occupants.append(probe["sid"])
+        assert shed is not None, "pool under occupancy never shed"
+        assert shed["accepted"] is False and shed["shed"] is True
+        assert isinstance(shed["retry_after_s"], float)
+        assert shed["retry_after_s"] > 0
+        assert "page pressure" in shed["message"]
+        assert gw.admission.shed_pages_total >= 1
+        # every accepted stream completes cleanly — pressure sheds, it
+        # never errors — and the drained pool serves new work again
+        for sid in occupants:
+            out = _poll_done(client, sid)
+            assert out.get("error") is None, out
+        out = client.generate([1, 2, 3, 4], 8)
+        assert not out.get("shed") and not out.get("error")
+        assert gw.scheduler.streams_errored_total == 0
+
+
+def _poll_done(client, sid, deadline_s=60.0):
+    deadline = time.monotonic() + deadline_s
+    cursor = 0
+    tokens = []
+    while time.monotonic() < deadline:
+        out = client.poll(sid, cursor)
+        tokens.extend(out.get("tokens") or [])
+        cursor = int(out.get("cursor") or cursor)
+        if out.get("done"):
+            out["tokens"] = tokens
+            return out
+        time.sleep(0.01)
+    raise AssertionError(f"stream {sid} never finished")
+
+
+def test_preemption_recompute_is_token_identical(swarm):
+    """A pool too small for every admitted stream's full depth forces
+    preemption; the victim is requeued with prompt+tokens and greedy
+    determinism makes its final stream IDENTICAL to an uncontended
+    run."""
+    model, params = swarm
+    prompts = [[1, 2], [9, 8]]
+    n_new = SEQ - 2
+    ref = {}
+    for p in prompts:
+        ref[tuple(p)] = SwarmKVDecoder(model, params, max_slots=1).generate(
+            [p], max_new_tokens=n_new
+        )[0]
+    with Gateway(
+        model, params, max_slots=2, max_pending=64,
+        page_len=2, num_pages=10,  # 9 usable < 2 streams × 8 pages
+        prefix_cache=False, prefill_chunk_tokens=4,
+    ) as gw:
+        client = GatewayClient(gw.endpoint)
+        # enqueue directly on the scheduler (admission would serialise
+        # them and hide the contention this test exists to create)
+        sids = [gw.scheduler.submit(p, n_new) for p in prompts]
+        for p, sid in zip(prompts, sids):
+            out = _poll_done(client, sid)
+            assert out.get("error") is None, out
+            assert out["tokens"] == ref[tuple(p)]
+        assert gw.scheduler.preemptions_total >= 1, (
+            "9 usable pages cannot hold two 8-page streams — a "
+            "preemption must have happened"
+        )
+        assert gw.scheduler.streams_errored_total == 0
+
+
+def test_prompt_that_can_never_fit_pool_errors_cleanly(swarm):
+    """A prompt within seq_len but larger than the WHOLE pool must be an
+    error frame at submit — requeueing it would wedge admission
+    forever."""
+    model, params = swarm
+    from learning_at_home_tpu.utils.connection import RemoteCallError
+
+    with Gateway(
+        model, params, max_slots=2, page_len=2, num_pages=4,  # 3 usable
+    ) as gw:
+        client = GatewayClient(gw.endpoint)
+        with pytest.raises(RemoteCallError):
+            client.submit(list(range(1, 11)), 4)  # needs 5-6 pages
+        # small prompts still serve
+        out = client.generate([1, 2, 3], 2)
+        assert not out.get("error") and len(out["tokens"]) == 2
+        assert gw.scheduler.streams_errored_total == 0
+
+
+# ---------------------------------------------------------------------------
+# gateway end-to-end: chunked prefill + paged default serve correctly
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_chunked_prefill_tokens_match_serial(swarm):
+    """The same workload through chunked-prefill and serial-prefill
+    gateways produces identical per-stream tokens — interleaving is a
+    latency policy, not an output policy."""
+    model, params = swarm
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8, 9, 10, 11, 12], [7, 8]]
+    results = {}
+    for label, chunk in (("chunked", 4), ("serial", 0)):
+        with Gateway(
+            model, params, max_slots=4, prefill_chunk_tokens=chunk
+        ) as gw:
+            client = GatewayClient(gw.endpoint)
+            outs = [client.generate(p, 4) for p in prompts]
+            assert all(
+                not o.get("shed") and not o.get("error") for o in outs
+            )
+            results[label] = [o["tokens"] for o in outs]
+            if chunk:
+                assert gw.decoder.prefill_chunks_total >= 3
+    assert results["chunked"] == results["serial"]

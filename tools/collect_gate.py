@@ -739,7 +739,13 @@ def gateway_smoke() -> int:
     rate) and the coalescer must have grouped overlapping expert sets:
     the number of pack-once dispatches actually fired must be STRICTLY
     less than the per-stream dispatch count an ungrouped gateway would
-    have issued (fired + coalesced-away)."""
+    have issued (fired + coalesced-away).
+
+    ISSUE 13 adds a shared-prefix phase against the same (warm) gateway:
+    every prompt opens with one fixed 16-token prefix spanning two KV
+    pages, so the content-addressed prefix cache MUST report hits
+    (``prefix_hits_total > 0``) — the pages were registered by the
+    earlier arrivals of the same phase and by the phase-one load."""
     import jax
 
     from experiments.loadgen import run_load
@@ -777,15 +783,37 @@ def gateway_smoke() -> int:
         )
         model = SwarmDMoETransformerLM(cfg, source)
         params = model.init_params(jax.random.PRNGKey(0))
-        with Gateway(model, params, max_slots=8, coalesce=True) as gw:
+        with Gateway(
+            model, params, max_slots=8, coalesce=True, page_len=8
+        ) as gw:
             rep = run_load(
                 gw.endpoint, rate_hz=40.0, duration_s=0.2,
                 prompt_len=(6, 6), max_new=(8, 8), vocab=64, seed=0,
             )
             co = gw.coalescer.stats()
+            # shared-prefix phase on the SAME warm gateway: two runs with
+            # one seed share one 16-token prefix (= 2 full 8-token
+            # pages); the first registers the pages, the second must hit
+            prep = None
+            for _round in range(2):
+                prep = run_load(
+                    gw.endpoint, rate_hz=20.0, duration_s=0.2,
+                    prompt_len=(20, 20), max_new=(4, 6), vocab=64,
+                    seed=1, prefix_share=1.0, prefix_len=16,
+                )
+                assert prep["completed"] == prep["arrivals"], (
+                    f"dropped shared-prefix streams: {prep}"
+                )
+                assert prep["shed"] == prep["errors"] == 0, prep
+            hits = gw.decoder.kv.prefix_hits_total
+            hit_tokens = gw.decoder.kv.prefix_hit_tokens_total
         assert rep["arrivals"] >= 4, f"loadgen produced too few: {rep}"
         assert rep["completed"] == rep["arrivals"], f"dropped streams: {rep}"
         assert rep["shed"] == rep["errors"] == rep["crashes"] == 0, rep
+        assert hits > 0, (
+            "shared-prefix load produced no prefix-cache hits "
+            f"(prefix_hits_total={hits})"
+        )
         fired = co["group_dispatches_total"]
         per_stream = fired + co["coalesced_dispatches_total"]
         assert fired < per_stream, (
@@ -794,12 +822,13 @@ def gateway_smoke() -> int:
         )
         print(
             f"gateway: {rep['completed']} streams, {rep['tokens_served']} "
-            f"tokens, dispatches fired {fired} vs per-stream {per_stream}"
+            f"tokens, dispatches fired {fired} vs per-stream {per_stream}, "
+            f"prefix hits {hits} ({hit_tokens} tokens skipped)"
         )
     finally:
         shutdown_procs(procs)
         reset_client_rpc()
-    print("GATEWAY_SMOKE_OK coalesce=expert-set")
+    print("GATEWAY_SMOKE_OK coalesce=expert-set prefix=content-addressed")
     return 0
 
 

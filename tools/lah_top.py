@@ -10,8 +10,9 @@ and renders one aggregated view:
 
 - per-peer rows: role, health, request throughput, queue depth, overlap
   fraction, padding waste, degraded-averaging fraction; serving gateways
-  (ISSUE 12) additionally fill STREAMS/SLOTS/SHED from their ``gateway``
-  snapshot section;
+  (ISSUE 12/13) additionally fill STREAMS/SLOTS/SHED plus the paged-KV
+  columns PAGES (``used/total`` physical pages) and PFX-HIT
+  (prefix-cache hits) from their ``gateway`` snapshot section;
 - an expert table merged across servers: per-expert async update counts;
 - dead peers: ids seen in an earlier refresh whose record expired, plus
   peers whose record is live but whose endpoint stopped answering.
@@ -128,21 +129,36 @@ def peer_lifecycle(row: dict) -> tuple[str, str, str]:
     return state, f"{uptime}s", str(int(_num(lc.get("restarts"))))
 
 
-def peer_gateway(row: dict) -> tuple[str, str, str]:
-    """(STREAMS, SLOTS, SHED) strings for a peer row (ISSUE 12):
-    gateways advertise a ``gateway`` section in their snapshot (stream
-    counts, slot occupancy, admission sheds); peers without one —
-    servers, trainers — and malformed sections render dashes, never
-    crash (the telemetry reader contract)."""
+def peer_gateway(row: dict) -> tuple[str, str, str, str, str]:
+    """(STREAMS, SLOTS, SHED, PAGES, PFX-HIT) strings for a peer row
+    (ISSUE 12/13): gateways advertise a ``gateway`` section in their
+    snapshot (stream counts, slot occupancy, admission sheds, KV page
+    pool occupancy, prefix-cache hits); peers without one — servers,
+    trainers — and malformed sections render dashes, never crash (the
+    telemetry reader contract).  PAGES/PFX-HIT dash independently:
+    a dense-layout gateway has no page pool to report."""
     gw = _section(row, "gateway")
     slots = gw.get("slots")
     if not isinstance(slots, (int, float)) or isinstance(slots, bool):
-        return "-", "-", "-"
+        return "-", "-", "-", "-", "-"
+    pages_total = gw.get("kv_pages_total")
+    if (
+        isinstance(pages_total, (int, float))
+        and not isinstance(pages_total, bool)
+    ):
+        pages = (
+            f"{int(_num(gw.get('kv_pages_used')))}/{int(pages_total)}"
+        )
+        pfx = str(int(_num(gw.get("prefix_hits_total"))))
+    else:
+        pages, pfx = "-", "-"
     return (
         f"{int(_num(gw.get('streams_active')))}/"
         f"{int(_num(gw.get('streams_total')))}",
         f"{int(_num(gw.get('slots_in_use')))}/{int(slots)}",
         str(int(_num(gw.get("shed_total")))),
+        pages,
+        pfx,
     )
 
 
@@ -155,7 +171,8 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
         f"{'HEALTH':<12} {'JOBS':>8} "
         f"{'QDEPTH':>6} {'OVERLAP':>8} {'PADWASTE':>9} {'DISP':>8} "
         f"{'INFLT':>6} {'HEDGE(w/f)':>11} {'AVG(dg/ok)':>11} "
-        f"{'STREAMS':>9} {'SLOTS':>7} {'SHED':>6}",
+        f"{'STREAMS':>9} {'SLOTS':>7} {'SHED':>6} "
+        f"{'PAGES':>9} {'PFX-HIT':>7}",
     ]
     experts: dict[str, float] = {}
     # replication view (ISSUE 8): how many servers host each uid, which
@@ -188,7 +205,7 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
         hedge_w = int(_num(m.get("lah_client_hedge_wins_total")))
         hedge_f = int(_num(m.get("lah_client_hedge_fires_total")))
         state, uptime, rst = peer_lifecycle(row)
-        streams, slots, shed = peer_gateway(row)
+        streams, slots, shed, pages, pfx_hits = peer_gateway(row)
         lines.append(
             f"{row['peer_id']:<28.28} {row['role']:<8.8} "
             f"{state:<9.9} {uptime:>7} {rst:>3} "
@@ -200,7 +217,8 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
             f"{inflight:>6} "
             f"{hedge_w:>5}/{hedge_f:<5} "
             f"{int(degraded):>5}/{int(rounds):<5} "
-            f"{streams:>9} {slots:>7} {shed:>6}"
+            f"{streams:>9} {slots:>7} {shed:>6} "
+            f"{pages:>9} {pfx_hits:>7}"
         )
         for uid, n in _section(row, "experts").items():
             experts[uid] = experts.get(uid, 0) + _num(n)
