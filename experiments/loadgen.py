@@ -52,6 +52,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from learning_at_home_tpu.utils import sanitizer  # noqa: E402
+
 
 def _pct(values, q) -> float:
     return float(np.percentile(np.asarray(values), q)) if values else 0.0
@@ -111,7 +113,7 @@ def run_load(
         prefix_rng.randint(0, vocab, size=max(0, int(prefix_len))).tolist()
         if prefix_len > 0 else []
     )
-    lock = threading.Lock()
+    lock = sanitizer.lock("loadgen.report")
     report = {
         "arrivals": 0, "completed": 0, "shed": 0, "shed_with_retry_after": 0,
         "errors": 0, "crashes": 0, "tokens_served": 0,

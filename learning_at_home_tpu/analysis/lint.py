@@ -38,6 +38,44 @@ Every rule encodes an invariant this codebase has already been burned by
   never scheduled (``foo()`` instead of ``await foo()``) — it silently
   does nothing.
 
+Spec-conformance rules (ISSUE 14) check the CODE against the repo DOCS,
+so the docs stay a checked artifact instead of prose.  The docs are
+located by walking up from each linted file to the first directory
+containing ``docs/PROTOCOL.md``; when none is found (isolated temp
+trees) R8–R10 skip rather than guess:
+
+- **R8**  every wire op handled by a server-side dispatcher (a
+  ``msg_type == "..."`` / ``msg_type in (...)`` comparison in a module
+  that defines ``_dispatch`` or ``_serve``) must appear in a
+  PROTOCOL.md op table (a row whose first cell is a backticked name
+  under a ``| type | ... |`` header); and — when the linted set spans
+  the full package (both ``frontdoor.py`` and ``connection_handler.py``
+  present) — every documented op must be handled somewhere.  The
+  ``hello`` handshake is documented in prose, not a table
+  (``_R8_HANDSHAKE_OPS``).
+- **R9**  every headline metric name (a string literal matching
+  ``lah_[a-z0-9_]+``; dynamic-prefix literals ending ``_`` are skipped)
+  must appear in the OBSERVABILITY.md catalog — either verbatim or as a
+  family prefix (``lah_server_*``) plus the backticked suffix.
+- **R10**  every ``sanitizer.lock(name)`` name must appear in the
+  CONCURRENCY.md named-lock table with a declared ordering rank, and no
+  lexically nested acquisition (``with a: ... with b:``) may contradict
+  the ranks (ranks must strictly increase inward).
+- **R11**  a function called from an ``@runs_on``-asserted hot path
+  (dispatch/decode cores) that itself acquires a tracked lock must
+  carry its own ``@runs_on`` assertion or a baselined suppression —
+  thread-ownership claims must cover the whole reachable hot path, not
+  just its entry point.
+
+R3 (gateway extension, ISSUE 14): gateway/handoff bounded-concurrency
+constants — ``MAX_*SESSIONS`` class/module ints, ``*DEFAULT_PREFILL_
+CHUNK`` module ints, and integer-literal env fallbacks for
+``LAH_GW_*MAX*/*PENDING*/*CHUNK*`` knobs — must also sit below every
+``max_inflight`` default (each concurrent session/chunk holds an
+in-flight RPC window on the shared mux).  Dynamic defaults (e.g.
+admission's ``4 * max_slots``) are out of static reach and are checked
+at runtime by the quiesce audits instead.
+
 Suppressions: ``# lah-lint: ignore[R1]`` (or ``ignore[R1,R5]``) on the
 finding's line, or on a standalone comment line directly above it,
 baselines the finding; add a reason after the bracket.  Suppressed
@@ -63,6 +101,10 @@ RULES = {
     "R5": "msgpack meta dict with non-string keys",
     "R6": "bare or swallowed broad exception handler",
     "R7": "coroutine called without await (never scheduled)",
+    "R8": "wire op handled in code but missing from PROTOCOL.md (or vice versa)",
+    "R9": "metric name not in the OBSERVABILITY.md catalog",
+    "R10": "sanitizer lock name missing from CONCURRENCY.md lock table or nested against its rank",
+    "R11": "lock-acquiring function on a @runs_on hot path without its own @runs_on",
 }
 
 _SUPPRESS_RE = re.compile(r"lah-lint:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -89,6 +131,22 @@ _META_CALLS = {  # callee tail -> positional index of the meta argument
     "rpc": 2,
     "rpc_prepared": 2,
 }
+
+# R3 gateway extension: bounded-concurrency constants by NAME …
+_GW_BOUND_CONST_RE = re.compile(
+    r"^_?(?:MAX_(?:[A-Z0-9]+_)*SESSIONS|(?:[A-Z0-9]+_)*DEFAULT_PREFILL_CHUNK)$"
+)
+# … and by env knob with a static integer fallback
+_GW_ENV_BOUND_RE = re.compile(r"^LAH_GW_[A-Z0-9_]*(?:MAX|PENDING|CHUNK)[A-Z0-9_]*$")
+
+# R9: headline metric literals; names ending "_" are dynamic prefixes
+# (f-string families like wire_codec_payloads_total_codec_<name>)
+_METRIC_LITERAL_RE = re.compile(r"^lah_[a-z0-9_]*[a-z0-9]$")
+
+# R8: ops documented in PROTOCOL.md prose (handshake), not in an op table
+_R8_HANDSHAKE_OPS = {"hello"}
+
+_BACKTICKED_LOCK_RE = re.compile(r"`([a-z0-9_.]+)`")
 
 
 @dataclasses.dataclass
@@ -152,13 +210,19 @@ def _suppressions(source: str) -> dict[int, set]:
 
 
 class _ModuleFacts:
-    """Per-module inputs to the cross-module rules R3/R4."""
+    """Per-module inputs to the cross-module rules R3/R4/R8–R10."""
 
     def __init__(self) -> None:
         self.fanout_consts: list[tuple[int, int, str, int]] = []  # line,col,name,val
+        self.gw_bound_consts: list[tuple[int, int, str, int]] = []  # line,col,name,val
         self.inflight_defaults: list[tuple[int, int]] = []  # line,val
         self.mentions_avg_part = False
         self.pool_ctor_calls: list[tuple[int, int, str, bool]] = []  # line,col,name,has_require_v2
+        self.defines_dispatch = False  # module defines _dispatch/_serve (R8)
+        self.handled_ops: list[tuple[int, int, str]] = []  # line,col,op
+        self.metric_literals: list[tuple[int, int, str]] = []  # line,col,name
+        self.lock_names: list[tuple[int, int, str]] = []  # line,col,name
+        self.lock_edges: list[tuple[int, int, str, str]] = []  # line,col,outer,inner
 
 
 class _Visitor(ast.NodeVisitor):
@@ -221,6 +285,8 @@ class _Visitor(ast.NodeVisitor):
                 self.facts.inflight_defaults.append((node.lineno, default.value))
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node.name in ("_dispatch", "_serve"):
+            self.facts.defines_dispatch = True
         self._collect_defaults(node)
         self._func_stack.append(node)
         self.generic_visit(node)
@@ -229,6 +295,8 @@ class _Visitor(ast.NodeVisitor):
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         # async_funcs / async_methods are filled by lint_paths' pre-pass
         # (call sites may lexically precede the definitions they target)
+        if node.name in ("_dispatch", "_serve"):
+            self.facts.defines_dispatch = True
         self._collect_defaults(node)
         self._func_stack.append(node)
         self.generic_visit(node)
@@ -255,17 +323,53 @@ class _Visitor(ast.NodeVisitor):
     def _check_fanout_const(self, target, value, node) -> None:
         if (
             isinstance(target, ast.Name)
-            and _FANOUT_CONST_RE.match(target.id)
             and isinstance(value, ast.Constant)
             and isinstance(value.value, int)
         ):
-            self.facts.fanout_consts.append(
-                (node.lineno, node.col_offset, target.id, value.value)
-            )
+            if _FANOUT_CONST_RE.match(target.id):
+                self.facts.fanout_consts.append(
+                    (node.lineno, node.col_offset, target.id, value.value)
+                )
+            elif _GW_BOUND_CONST_RE.match(target.id):
+                self.facts.gw_bound_consts.append(
+                    (node.lineno, node.col_offset, target.id, value.value)
+                )
 
     def visit_Constant(self, node: ast.Constant) -> None:
         if node.value == "avg_part":
             self.facts.mentions_avg_part = True
+        if (
+            isinstance(node.value, str)
+            and _METRIC_LITERAL_RE.match(node.value)
+        ):
+            self.facts.metric_literals.append(
+                (node.lineno, node.col_offset, node.value)
+            )
+        self.generic_visit(node)
+
+    # -- R8 facts: handled wire ops ---------------------------------------
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        left = node.left
+        if isinstance(left, ast.Name) and left.id == "msg_type":
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    isinstance(comp, ast.Constant)
+                    and isinstance(comp.value, str)
+                ):
+                    self.facts.handled_ops.append(
+                        (comp.lineno, comp.col_offset, comp.value)
+                    )
+                elif isinstance(op, (ast.In, ast.NotIn)) and isinstance(
+                    comp, (ast.Tuple, ast.List, ast.Set)
+                ):
+                    for el in comp.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str
+                        ):
+                            self.facts.handled_ops.append(
+                                (el.lineno, el.col_offset, el.value)
+                            )
         self.generic_visit(node)
 
     # -- R6 ---------------------------------------------------------------
@@ -329,6 +433,30 @@ class _Visitor(ast.NodeVisitor):
         dotted = _dotted(node.func, self.aliases)
         tail = dotted.split(".")[-1] if dotted else None
         awaited = id(node) in self._awaited
+
+        # R10 facts: named tracked locks
+        lock_name = _sanitizer_lock_name(node, self.aliases)
+        if lock_name is not None:
+            self.facts.lock_names.append(
+                (node.lineno, node.col_offset, lock_name)
+            )
+
+        # R3 gateway facts: integer-literal env fallbacks for bounded-
+        # concurrency knobs (dynamic defaults are out of static reach)
+        if dotted == "os.environ.get" and len(node.args) >= 2:
+            key, default = node.args[0], node.args[1]
+            if (
+                isinstance(key, ast.Constant)
+                and isinstance(key.value, str)
+                and _GW_ENV_BOUND_RE.match(key.value)
+                and isinstance(default, ast.Constant)
+                and isinstance(default.value, str)
+                and default.value.isdigit()
+            ):
+                self.facts.gw_bound_consts.append(
+                    (node.lineno, node.col_offset, key.value,
+                     int(default.value))
+                )
 
         # R4 facts: pool constructions in held-reply modules
         if tail in ("PoolRegistry", "ConnectionPool"):
@@ -463,6 +591,295 @@ class _Visitor(ast.NodeVisitor):
                 self._check_msgpack_keys(v)
 
 
+def _sanitizer_lock_name(node: ast.AST, aliases: dict) -> Optional[str]:
+    """The name argument of a ``sanitizer.lock("...")`` call (any import
+    spelling that resolves to it), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = _dotted(node.func, aliases)
+    if not dotted or not dotted.endswith("sanitizer.lock"):
+        return None
+    if (
+        node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        return node.args[0].value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# R8–R10 doc corpus: parsed once per docs/ directory
+# ---------------------------------------------------------------------------
+
+_DOC_CACHE: dict[str, dict] = {}
+
+
+def _find_docs_dir(path: str) -> Optional[str]:
+    """Walk up from a linted file to the first dir holding docs/PROTOCOL.md."""
+    d = os.path.dirname(os.path.abspath(path))
+    while True:
+        cand = os.path.join(d, "docs")
+        if os.path.isfile(os.path.join(cand, "PROTOCOL.md")):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def _doc_corpus(docs_dir: str) -> dict:
+    cached = _DOC_CACHE.get(docs_dir)
+    if cached is not None:
+        return cached
+    corpus = {
+        "protocol_path": os.path.join(docs_dir, "PROTOCOL.md"),
+        "concurrency_path": os.path.join(docs_dir, "CONCURRENCY.md"),
+        "ops": {},  # op name -> PROTOCOL.md line of its table row
+        "metric_tokens": set(),
+        "metric_families": [],
+        "have_observability": False,
+        "lock_ranks": {},  # lock name -> int rank
+        "have_concurrency": False,
+    }
+    # PROTOCOL.md op tables: rows whose first cell is a backticked name,
+    # under a table header whose first cell is "type"
+    try:
+        with open(corpus["protocol_path"], encoding="utf-8") as fh:
+            in_op_table = False
+            for lineno, raw in enumerate(fh, 1):
+                s = raw.strip()
+                if not s.startswith("|"):
+                    in_op_table = False
+                    continue
+                cells = [c.strip() for c in s.strip("|").split("|")]
+                if cells and cells[0] == "type":
+                    in_op_table = True
+                    continue
+                if in_op_table and cells:
+                    m = re.fullmatch(r"`([a-z][a-z0-9_]*)`", cells[0])
+                    if m:
+                        corpus["ops"].setdefault(m.group(1), lineno)
+    except OSError:
+        pass
+    # OBSERVABILITY.md: every backticked token (label suffixes like
+    # `{type=}` stripped); `lah_x_*` tokens declare family prefixes
+    try:
+        with open(os.path.join(docs_dir, "OBSERVABILITY.md"),
+                  encoding="utf-8") as fh:
+            text = fh.read()
+        corpus["have_observability"] = True
+        toks = {
+            t.split("{")[0].strip()
+            for t in re.findall(r"`([^`\n]+)`", text)
+        }
+        corpus["metric_tokens"] = toks
+        corpus["metric_families"] = sorted(
+            t[:-1] for t in toks if t.startswith("lah_") and t.endswith("_*")
+        )
+    except OSError:
+        pass
+    # CONCURRENCY.md lock table: | `name` | rank | ... | under the
+    # "Lock node" header
+    try:
+        with open(corpus["concurrency_path"], encoding="utf-8") as fh:
+            in_lock_table = False
+            for raw in fh:
+                s = raw.strip()
+                if not s.startswith("|"):
+                    in_lock_table = False
+                    continue
+                cells = [c.strip() for c in s.strip("|").split("|")]
+                if cells and cells[0] == "Lock node":
+                    in_lock_table = True
+                    continue
+                if in_lock_table and len(cells) >= 2:
+                    try:
+                        rank = int(cells[1])
+                    except ValueError:
+                        continue  # separator row
+                    for nm in _BACKTICKED_LOCK_RE.findall(cells[0]):
+                        corpus["lock_ranks"][nm] = rank
+        corpus["have_concurrency"] = True
+    except OSError:
+        pass
+    _DOC_CACHE[docs_dir] = corpus
+    return corpus
+
+
+def _metric_documented(name: str, corpus: dict) -> bool:
+    toks = corpus["metric_tokens"]
+    if name in toks:
+        return True
+    return any(
+        name.startswith(fam) and name[len(fam):] in toks
+        for fam in corpus["metric_families"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# R10/R11 structural pass: lock aliases, lexical nesting, hot-path reach
+# ---------------------------------------------------------------------------
+
+
+def _lock_alias_map(tree: ast.AST, aliases: dict) -> dict:
+    """('attr', class, attr)/('mod', None, name) -> lock name, from
+    ``self.x = sanitizer.lock("n")`` / ``x = sanitizer.lock("n")``."""
+    amap: dict = {}
+
+    def scan(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                scan(child, child.name)
+                continue
+            if isinstance(child, ast.Assign):
+                nm = _sanitizer_lock_name(child.value, aliases)
+                if nm:
+                    for t in child.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            amap[("attr", cls, t.attr)] = nm
+                        elif isinstance(t, ast.Name):
+                            amap[("mod", None, t.id)] = nm
+            scan(child, cls)
+
+    scan(tree, None)
+    return amap
+
+
+def _resolve_lock_expr(
+    expr: ast.AST, amap: dict, aliases: dict, cls: Optional[str]
+) -> Optional[str]:
+    nm = _sanitizer_lock_name(expr, aliases)
+    if nm:
+        return nm
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return amap.get(("attr", cls, expr.attr))
+    if isinstance(expr, ast.Name):
+        return amap.get(("mod", None, expr.id))
+    return None
+
+
+def _collect_lock_edges(
+    tree: ast.AST, amap: dict, aliases: dict
+) -> list[tuple[int, int, str, str]]:
+    """(line, col, outer, inner) for every lexically nested acquisition
+    of two resolvable tracked locks."""
+    edges: list[tuple[int, int, str, str]] = []
+
+    def walk(node: ast.AST, held: list, cls: Optional[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            cls = node.name
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            held = []  # a nested def does not run under the enclosing with
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                nm = _resolve_lock_expr(item.context_expr, amap, aliases, cls)
+                if nm:
+                    for h in held:
+                        edges.append(
+                            (node.lineno, node.col_offset, h, nm)
+                        )
+                    held = held + [nm]
+            for b in node.body:
+                walk(b, held, cls)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, cls)
+
+    walk(tree, [], None)
+    return edges
+
+
+def _r11_findings(
+    path: str, tree: ast.AST, amap: dict, aliases: dict
+) -> list[Finding]:
+    """Functions called from an @runs_on-decorated function (direct
+    ``self.m()`` / bare same-module calls) that acquire a tracked lock
+    but carry no @runs_on of their own."""
+    funcs: dict = {}  # (class, name) -> (def node, decorated?)
+
+    def collect(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                collect(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                decorated = any(
+                    "runs_on" in ast.unparse(d) for d in child.decorator_list
+                )
+                funcs[(cls, child.name)] = (child, decorated)
+                collect(child, cls)
+            else:
+                collect(child, cls)
+
+    collect(tree, None)
+
+    def acquires(node: ast.AST, cls: Optional[str]) -> Optional[str]:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    nm = _resolve_lock_expr(
+                        item.context_expr, amap, aliases, cls
+                    )
+                    if nm:
+                        return nm
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "acquire"
+            ):
+                nm = _resolve_lock_expr(sub.func.value, amap, aliases, cls)
+                if nm:
+                    return nm
+        return None
+
+    findings: list[Finding] = []
+    flagged: set = set()
+    for (cls, name), (node, decorated) in funcs.items():
+        if not decorated:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+            ):
+                target = (cls, fn.attr)
+            elif isinstance(fn, ast.Name):
+                target = (None, fn.id)
+            else:
+                continue
+            if target not in funcs or target in flagged:
+                continue
+            tnode, tdecorated = funcs[target]
+            if tdecorated:
+                continue
+            lock_nm = acquires(tnode, target[0])
+            if lock_nm is None:
+                continue
+            flagged.add(target)
+            findings.append(
+                Finding(
+                    path, tnode.lineno, tnode.col_offset, "R11",
+                    f"`{target[1]}` acquires tracked lock `{lock_nm}` and "
+                    f"is called from @runs_on hot path `{name}` but carries "
+                    "no @runs_on assertion — thread ownership must cover "
+                    "the whole reachable hot path",
+                )
+            )
+    return findings
+
+
 def _iter_py_files(paths: Iterable[str]) -> list[str]:
     out: list[str] = []
     for p in paths:
@@ -515,6 +932,12 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
                             node.name, set()
                         ).add(sub.name)
         visitor.visit(tree)
+        # structural pass: lock aliases feed R10 nesting edges + R11
+        amap = _lock_alias_map(tree, visitor.aliases)
+        visitor.facts.lock_edges = _collect_lock_edges(
+            tree, amap, visitor.aliases
+        )
+        findings.extend(_r11_findings(path, tree, amap, visitor.aliases))
         findings.extend(visitor.findings)
         all_facts.append((path, visitor.facts))
 
@@ -539,6 +962,25 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
                         )
                     )
 
+    # R3 gateway extension: bounded-concurrency gateway/handoff constants
+    # join the same comparison (each concurrent session/chunk holds an
+    # in-flight RPC window on the shared mux)
+    if inflight:
+        limit = min(v for _, _, v in inflight)
+        where = next((f"{p}:{ln}" for p, ln, v in inflight if v == limit), "?")
+        for path, facts in all_facts:
+            for line, col, name, val in facts.gw_bound_consts:
+                if val >= limit:
+                    findings.append(
+                        Finding(
+                            path, line, col, "R3",
+                            f"{name}={val} must be < the mux in-flight "
+                            f"limit {limit} ({where}): every concurrent "
+                            "gateway session/chunk holds an in-flight RPC "
+                            "window",
+                        )
+                    )
+
     # R4: held-reply modules must pin require_v2=True on their pools
     for path, facts in all_facts:
         if not facts.mentions_avg_part:
@@ -551,6 +993,85 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
                         f"{name}(...) in a held-reply (avg_part) module "
                         "without require_v2=True — held replies starve "
                         "v1's one-RPC-per-socket pool",
+                    )
+                )
+
+    # R8–R10: spec conformance against the repo docs.  Docs are located
+    # per linted file (so the corpus under tests/ resolves the real
+    # repo docs); files with no docs in reach skip these rules.
+    handled_ops_all: set = set(_R8_HANDSHAKE_OPS)
+    for _, facts in all_facts:
+        handled_ops_all.update(op for _, _, op in facts.handled_ops)
+    basenames = {os.path.basename(p) for p, _ in all_facts}
+    reverse_r8_docs: Optional[dict] = None
+    for path, facts in all_facts:
+        docs_dir = _find_docs_dir(path)
+        if docs_dir is None:
+            continue
+        corpus = _doc_corpus(docs_dir)
+        if facts.defines_dispatch and corpus["ops"]:
+            if os.path.basename(path) in (
+                "frontdoor.py", "connection_handler.py"
+            ):
+                reverse_r8_docs = corpus
+            for line, col, op in facts.handled_ops:
+                if op not in corpus["ops"] and op not in _R8_HANDSHAKE_OPS:
+                    findings.append(
+                        Finding(
+                            path, line, col, "R8",
+                            f"handled op `{op}` is not documented in any "
+                            f"PROTOCOL.md op table "
+                            f"({corpus['protocol_path']})",
+                        )
+                    )
+        if corpus["have_observability"]:
+            for line, col, name in facts.metric_literals:
+                if not _metric_documented(name, corpus):
+                    findings.append(
+                        Finding(
+                            path, line, col, "R9",
+                            f"metric `{name}` is not in the "
+                            "OBSERVABILITY.md catalog (add it verbatim or "
+                            "as family prefix + suffix)",
+                        )
+                    )
+        if corpus["have_concurrency"]:
+            ranks = corpus["lock_ranks"]
+            for line, col, name in facts.lock_names:
+                if name not in ranks:
+                    findings.append(
+                        Finding(
+                            path, line, col, "R10",
+                            f"lock `{name}` has no row/rank in the "
+                            "CONCURRENCY.md named-lock table",
+                        )
+                    )
+            for line, col, outer, inner in facts.lock_edges:
+                ra, rb = ranks.get(outer), ranks.get(inner)
+                if ra is not None and rb is not None and ra >= rb:
+                    findings.append(
+                        Finding(
+                            path, line, col, "R10",
+                            f"lock `{inner}` (rank {rb}) acquired while "
+                            f"holding `{outer}` (rank {ra}) — ranks must "
+                            "strictly increase inward "
+                            "(docs/CONCURRENCY.md lock table)",
+                        )
+                    )
+    # R8 reverse direction: only meaningful when the linted set spans the
+    # full package (both dispatcher families present)
+    if (
+        reverse_r8_docs is not None
+        and {"frontdoor.py", "connection_handler.py"} <= basenames
+    ):
+        for op, doc_line in sorted(reverse_r8_docs["ops"].items()):
+            if op not in handled_ops_all:
+                findings.append(
+                    Finding(
+                        reverse_r8_docs["protocol_path"], doc_line, 0, "R8",
+                        f"documented op `{op}` has no handler in the "
+                        "linted set (stale PROTOCOL.md row or missing "
+                        "dispatch arm)",
                     )
                 )
 

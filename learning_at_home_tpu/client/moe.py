@@ -323,6 +323,28 @@ class RemoteMixtureOfExperts:
             return None if moe is None else moe._headline_metrics()
 
         _registry.register_collector(f"moe-{id(self)}", _collect)
+        # quiesce-point audit (sanitizer-gated, weakly held): when the
+        # client claims idle (reset_client_rpc), every fired dispatch
+        # must have been joined or cancelled — a non-zero gauge there is
+        # a leaked fan-out holding server-side sessions
+        sanitizer.register_quiesce_audit(
+            f"client.moe.{id(self):x}", self._quiesce_audit
+        )
+
+    def _quiesce_audit(self) -> list:
+        leaks = []
+        if self.inflight_dispatches:
+            leaks.append(
+                f"inflight_dispatches gauge is {self.inflight_dispatches} "
+                "at client quiesce — fired fan-out never joined/cancelled"
+            )
+        with self._sessions_lock:
+            pending = len(self._pending) + len(self._pending_bwd)
+        if pending:
+            leaks.append(
+                f"{pending} unjoined dispatch ticket(s) at client quiesce"
+            )
+        return leaks
 
     @staticmethod
     def _make_load_getter(source, prefix: str):
@@ -539,6 +561,7 @@ class RemoteMixtureOfExperts:
         base = self.forward_timeout if kind == "forward" else self.backward_timeout
         return base + self.timeout_after_k_min + JOIN_GRACE_S
 
+    @sanitizer.runs_on("host", site="moe.join_exit")
     def _make_join_exit(self, trace):
         """on_join_exit hook: overlap accounting + the in-flight gauge,
         run in join's finally on the joining host thread — it fires even
@@ -750,6 +773,7 @@ class RemoteMixtureOfExperts:
             self.inflight_dispatches += 1
         return fut
 
+    @sanitizer.runs_on("host", site="moe._finalize_forward")
     def _finalize_forward(
         self, results, *, x, coords, sel, batch, store_session, session_id,
         trace, t0, t_end=None,
@@ -1315,6 +1339,7 @@ class RemoteMixtureOfExperts:
             self.inflight_dispatches += 1
         return fut
 
+    @sanitizer.runs_on("host", site="moe._finalize_backward")
     def _finalize_backward(self, results, *, session, fwd_dropped, gy, batch):
         gx = np.zeros((batch, gy.shape[-1]), gy.dtype)
         ok = np.zeros(batch, np.int64)

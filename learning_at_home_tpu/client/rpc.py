@@ -21,7 +21,7 @@ from learning_at_home_tpu.utils.connection import PoolRegistry, force_protocol_v
 
 logger = logging.getLogger(__name__)
 
-_lock = threading.Lock()
+_lock = sanitizer.lock("client.rpc.state")
 _loop: Optional[BackgroundLoop] = None
 _registry: Optional[PoolRegistry] = None
 _sync_dispatch_set = False
@@ -359,6 +359,10 @@ def pool_registry() -> PoolRegistry:
 def reset_client_rpc() -> None:
     """Close all client connections and the loop (test teardown helper)."""
     global _loop, _registry
+    # the caller is declaring the client side idle: every fired dispatch
+    # should have been joined or cancelled by now — audit the gauges
+    # before tearing the loop down (sanitizer-gated, no-op in production)
+    sanitizer.quiesce_point("client")
     with _lock:
         if _registry is not None:
             registry = _registry

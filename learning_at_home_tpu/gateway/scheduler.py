@@ -57,6 +57,35 @@ _DEFAULT_STREAM_TTL_S = 600.0
 _DEFAULT_PREFILL_CHUNK = 32
 
 
+def _monotonic() -> float:
+    """Clock seam: every internal timestamp flows through here so the
+    lah-verify interleaving explorer can drive the scheduler on a virtual
+    clock (deterministic TTL-GC / age ordering across replayed schedules)."""
+    return time.monotonic()
+
+
+# Machine-checked invariants over this module, in the shape lah-verify
+# aggregates: (name, what the checker asserts).  ``scheduler.*`` names are
+# enforced by :meth:`SlotScheduler.audit` on every explored interleaving;
+# the quiesce leak check runs at claimed-idle points under LAH_SANITIZE=1.
+# docs/CONCURRENCY.md "Verified invariants" mirrors this table.
+VERIFIED_INVARIANTS = (
+    ("scheduler.slot_unique",
+     "no two non-done streams ever reference the same decoder slot"),
+    ("scheduler.done_slotless",
+     "a done stream holds no slot (slot freed before done is set)"),
+    ("scheduler.counter_conservation",
+     "streams_total == finished + errored + cancelled + still-open "
+     "(catches _finish double-counting a stream)"),
+    ("scheduler.slot_table_consistent",
+     "every decoder-side live/prefilling slot is owned by exactly one "
+     "non-done stream (no leaked or doubly-owned slots)"),
+    ("scheduler.quiesce_baseline",
+     "at scheduler idle (no open streams, empty queue) no slot is in "
+     "use and the KV page pool accounting is internally consistent"),
+)
+
+
 @dataclasses.dataclass
 class StreamState:
     sid: str
@@ -68,7 +97,9 @@ class StreamState:
     cancelled: bool = False
     slot: Optional[int] = None
     prefilling: bool = False
-    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    submitted_at: float = dataclasses.field(
+        default_factory=lambda: _monotonic()
+    )
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
 
@@ -129,7 +160,14 @@ class SlotScheduler:
         # decode-step wall time EMA (seconds) — the admission controller's
         # retry-after scale
         self.step_time_ema: Optional[float] = None
-        self._last_gc = time.monotonic()
+        self._last_gc = _monotonic()
+        # resource-leak audit at claimed-idle points (sanitizer-gated;
+        # no-op in production).  Per-instance site so one scheduler's
+        # quiesce check never reads another's mid-work state; bound
+        # method held weakly, so no unregister needed on teardown.
+        self._quiesce_site = f"gateway.scheduler.{id(self):x}"
+        sanitizer.register_quiesce_audit(self._quiesce_site,
+                                         self._quiesce_audit)
 
     # ---- lifecycle ----
 
@@ -267,11 +305,15 @@ class SlotScheduler:
                 logger.exception("gateway decode iteration failed")
                 worked = False
             if not worked:
+                # claimed-idle moment: nothing advanced this pass, so
+                # slot/page accounting must be back at baseline if the
+                # stream table is empty of open work (sanitizer-gated)
+                sanitizer.quiesce_point(self._quiesce_site)
                 self._wake.wait(timeout=self.idle_wait_s)
                 self._wake.clear()
 
     def _iteration(self) -> bool:
-        now = time.monotonic()
+        now = _monotonic()
         self._evict_cancelled(now)
         self._admit_pending(now)
         worked = self._prefill_chunks(now)
@@ -349,6 +391,18 @@ class SlotScheduler:
                 self._finish(st, now, cancelled=True)
                 continue
             prompt = self._effective_prompt(st)
+            if (
+                len(prompt) >= self.decoder.seq_len
+                and len(prompt) > len(st.prompt)
+            ):
+                # a preempted victim whose recompute prompt reached the
+                # cache edge: no row is left to prefill its next logits,
+                # but it did not fail — it hit capacity, exactly as if it
+                # had decoded to seq_len in place (found by lah-verify:
+                # erroring it here leaked a spurious client-visible
+                # failure under prefix-cache page pressure)
+                self._finish(st, now)
+                continue
             if not self._prompt_can_ever_fit(len(prompt)):
                 self._finish(
                     st, now,
@@ -405,7 +459,7 @@ class SlotScheduler:
             st.slot = slot
             st.prefilling = False
             if st.first_token_at is None:
-                st.first_token_at = time.monotonic()
+                st.first_token_at = _monotonic()
             st.tokens.append(tok)
             self.tokens_total += 1
             full = (
@@ -523,7 +577,7 @@ class SlotScheduler:
         live = self.decoder.live_slots()
         if not live:
             return False
-        t0 = time.monotonic()
+        t0 = _monotonic()
         try:
             nxt = self.decoder.decode_step()
         except Exception as e:
@@ -538,7 +592,7 @@ class SlotScheduler:
                 if st is not None:
                     self._finish(st, now, error=f"{type(e).__name__}: {e}")
             return True
-        dt = time.monotonic() - t0
+        dt = _monotonic() - t0
         self.step_time_ema = (
             dt if self.step_time_ema is None
             else 0.8 * self.step_time_ema + 0.2 * dt
@@ -581,3 +635,83 @@ class SlotScheduler:
         if stale:
             logger.info("gateway stream GC dropped %d stale results",
                         len(stale))
+
+    # ---- machine-checked invariants (lah-verify / sanitizer) ----
+
+    def audit(self) -> list[str]:
+        """Check every ``scheduler.*`` row of :data:`VERIFIED_INVARIANTS`
+        against the live state; returns violation strings (empty = clean).
+        Called by the lah-verify explorer after every step of every
+        explored interleaving, and by the quiesce audit at idle.  Must be
+        callable from the decode thread (reads decoder masks directly)."""
+        leaks: list[str] = []
+        with self._lock:
+            open_streams = [
+                st for st in self._streams.values() if not st.done
+            ]
+            slots: dict[int, str] = {}
+            for st in open_streams:
+                if st.slot is None:
+                    continue
+                if st.slot in slots:
+                    leaks.append(
+                        f"slot_unique: slot {st.slot} owned by both "
+                        f"{slots[st.slot]} and {st.sid}"
+                    )
+                slots[st.slot] = st.sid
+            for st in self._streams.values():
+                if st.done and st.slot is not None:
+                    leaks.append(
+                        f"done_slotless: done stream {st.sid} still "
+                        f"holds slot {st.slot}"
+                    )
+            closed = (
+                self.streams_finished_total + self.streams_errored_total
+                + self.streams_cancelled_total
+            )
+            if self.streams_total != closed + len(open_streams):
+                leaks.append(
+                    "counter_conservation: total "
+                    f"{self.streams_total} != closed {closed} + open "
+                    f"{len(open_streams)} (a _finish double-count or a "
+                    "lost stream)"
+                )
+        busy = getattr(self.decoder, "busy_slots", None)
+        if callable(busy):
+            decoder_side = set(busy())
+            table_side = set(slots)
+            for slot in decoder_side - table_side:
+                leaks.append(
+                    f"slot_table_consistent: decoder slot {slot} is "
+                    "live/prefilling but no open stream owns it (leak)"
+                )
+            for slot in table_side - decoder_side:
+                leaks.append(
+                    f"slot_table_consistent: stream {slots[slot]} claims "
+                    f"slot {slot} the decoder thinks is free"
+                )
+        kv_audit = getattr(
+            getattr(self.decoder, "kv", None), "audit", None
+        )
+        if callable(kv_audit):
+            leaks.extend(f"kv: {x}" for x in kv_audit())
+        return leaks
+
+    def _quiesce_audit(self) -> list[str]:
+        """Leak check at a claimed-idle moment.  Only bites when the
+        stream table holds no open work — mid-work calls return clean
+        rather than second-guess a busy scheduler."""
+        with self._lock:
+            busy = self._pending or any(
+                not st.done for st in self._streams.values()
+            )
+        if busy:
+            return []
+        leaks = self.audit()
+        in_use = self.slots_in_use()
+        if in_use:
+            leaks.append(
+                f"quiesce_baseline: {in_use} decoder slot(s) in use "
+                "with no open streams"
+            )
+        return leaks

@@ -56,6 +56,26 @@ import numpy as np
 _ROOT = b"kv-prefix-root"
 
 
+# Machine-checked invariants (lah-verify shape: (name, what is asserted)).
+# ``kv.*`` rows are enforced by :meth:`PagedKVCache.audit`, run by the
+# interleaving explorer after every explored step and by the scheduler's
+# quiesce audit; the shared-write ban is asserted inline on every scatter.
+VERIFIED_INVARIANTS = (
+    ("kv.refcount_conservation",
+     "every page's refcount equals its slot-table mappings plus its "
+     "prefix-cache hold (plus the scratch pin for page 0)"),
+    ("kv.pool_conservation",
+     "free-list pages are unreferenced and unique; every non-free page "
+     "is referenced — no page is both free and mapped, none leaks"),
+    ("kv.scratch_pinned",
+     "physical page 0 stays pinned at refcount 1: never allocated, "
+     "never freed, never mapped as a slot's logical page"),
+    ("kv.no_shared_page_writes",
+     "a refcount>1 page is immutable — write_tokens raises on any "
+     "write attempt (checked inline, copy-on-write discipline)"),
+)
+
+
 class PagePressure(RuntimeError):
     """No free physical page and nothing reclaimable — the caller
     (scheduler/admission) decides whether to requeue, preempt or shed;
@@ -333,6 +353,53 @@ class PagedKVCache:
         rows_j = jnp.asarray(rows, jnp.int32)
         self.k_pools[layer] = self.k_pools[layer].at[pids_j, rows_j].set(k)
         self.v_pools[layer] = self.v_pools[layer].at[pids_j, rows_j].set(v)
+
+    def audit(self) -> list[str]:
+        """Check the ``kv.*`` rows of :data:`VERIFIED_INVARIANTS` against
+        the live pool; returns violation strings (empty = clean).  Pure
+        accounting — safe to call between any two operations on the
+        owning thread (the explorer calls it after every step)."""
+        leaks: list[str] = []
+        expected = np.zeros(self.num_pages, np.int64)
+        expected[0] = 1  # the scratch pin
+        for slot in range(self.max_slots):
+            for logical in range(int(self.alloc_count[slot])):
+                pid = int(self.page_table[slot, logical])
+                if pid == 0:
+                    leaks.append(
+                        f"scratch_pinned: slot {slot} logical {logical} "
+                        "maps scratch page 0 as an allocated page"
+                    )
+                expected[pid] += 1
+        for e in self._entries.values():
+            expected[e.page_id] += 1
+        for pid in range(self.num_pages):
+            if int(self.refcount[pid]) != int(expected[pid]):
+                leaks.append(
+                    f"refcount_conservation: page {pid} refcount "
+                    f"{int(self.refcount[pid])} but {int(expected[pid])} "
+                    "references exist (slot mappings + prefix holds)"
+                )
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            leaks.append(
+                "pool_conservation: duplicate page(s) on the free list"
+            )
+        if 0 in free_set:
+            leaks.append("scratch_pinned: scratch page 0 is on the free list")
+        for pid in free_set - {0}:
+            if int(expected[pid]) or int(self.refcount[pid]):
+                leaks.append(
+                    f"pool_conservation: free page {pid} is still "
+                    "referenced or mapped"
+                )
+        for pid in range(1, self.num_pages):
+            if pid not in free_set and int(self.refcount[pid]) == 0:
+                leaks.append(
+                    f"pool_conservation: page {pid} leaked — neither "
+                    "free nor referenced"
+                )
+        return leaks
 
     def stats(self) -> dict:
         return {

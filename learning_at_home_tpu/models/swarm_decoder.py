@@ -196,6 +196,14 @@ class SwarmKVDecoder:
             if self.prefilling[i]
         ]
 
+    def busy_slots(self) -> list[int]:
+        """Slots live OR mid-prefill — the decoder-side ownership set the
+        scheduler's :meth:`SlotScheduler.audit` reconciles against its
+        stream table (slot-table leak freedom)."""
+        return [
+            int(s) for s in np.nonzero(self.live | self.prefilling)[0]
+        ]
+
     def at_capacity(self, slot: int) -> bool:
         """True when the slot has no cache row left for another token."""
         return int(self.pos[slot]) >= self.seq_len

@@ -21,6 +21,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from learning_at_home_tpu.utils import sanitizer
+
 logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -28,7 +30,7 @@ _SRC = os.path.join(_HERE, "framepump.cpp")
 _SO = os.path.join(_HERE, "_framepump.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = sanitizer.lock("native.lib")
 
 
 def _build() -> Optional[str]:
@@ -128,7 +130,7 @@ class FramePump:
         # during shutdown must either be queued on live C state or see
         # _closed — never call into freed memory.  next() is NOT guarded
         # (it blocks); callers must stop calling next() before shutdown().
-        self._call_lock = threading.Lock()
+        self._call_lock = sanitizer.lock("native.pump_call")
 
     def next(self, timeout: float = 0.2) -> Optional[tuple[int, bytes]]:
         """Next complete inbound frame as (conn_id, payload).

@@ -49,6 +49,40 @@ logger = logging.getLogger(__name__)
 
 Endpoint = tuple[str, int]
 
+
+def _monotonic() -> float:
+    """Clock seam — the lah-verify explorer replays drain/handoff
+    sequences on a virtual clock (deterministic session-TTL expiry and
+    quiesce deadlines across interleavings)."""
+    return time.monotonic()
+
+
+def _sleep(seconds: float) -> None:
+    """Sleep seam — under the explorer, a drain 'sleep' is a scheduling
+    point (advance the virtual clock, maybe switch actors), not a wall
+    wait."""
+    time.sleep(seconds)
+
+
+# Machine-checked invariants (lah-verify shape: (name, what is
+# asserted)); enforced by the explorer's lifecycle world against a real
+# run_drain / HandoffReceiver driven through the seams above.
+VERIFIED_INVARIANTS = (
+    ("lifecycle.drain_no_abort",
+     "a drain that quiesced in budget never retires an expert while the "
+     "server still reports in-flight batches — draining waits, it never "
+     "aborts work"),
+    ("lifecycle.finish_drain_always",
+     "_finish_drain runs on every drain path, success or failure — the "
+     "server can never be wedged in DRAINING"),
+    ("lifecycle.no_state_dropped",
+     "every expert is handed off, checkpointed, or explicitly reported "
+     "failed — no training state silently vanishes in a drain"),
+    ("lifecycle.handoff_sessions_bounded",
+     "the receiver never holds more than MAX_SESSIONS half-open "
+     "sessions, and abandoned sessions are TTL-garbage-collected"),
+)
+
 # Lifecycle states a server advertises (stats RPC + telemetry extras;
 # lah_top renders them).  DEAD is never self-reported — it is the
 # observer-side verdict when a peer's telemetry record expired.
@@ -240,7 +274,7 @@ class _HandoffSession:
         self.update_count = update_count
         self.leaves: list = []
         self.next_part = 0
-        self.created_at = time.monotonic()
+        self.created_at = _monotonic()
 
 
 class HandoffReceiver:
@@ -262,7 +296,7 @@ class HandoffReceiver:
         self.rejected = 0       # refused / failed / mismatched transfers
 
     def _gc(self) -> None:
-        now = time.monotonic()
+        now = _monotonic()
         for key in [
             k for k, s in self._sessions.items()
             if now - s.created_at > HANDOFF_SESSION_TTL_S
@@ -531,7 +565,7 @@ def run_drain(
     Runs on a host thread (asserted via the sanitizer in
     ``Server.drain``); never call on a server loop.
     """
-    t0 = time.monotonic()
+    t0 = _monotonic()
     summary: dict[str, Any] = {
         "handed_off": [], "checkpointed": [], "failed": [],
         "successor": None,
@@ -557,17 +591,17 @@ def run_drain(
                 "drain: serving through the %.1fs record-expiry grace "
                 "window", grace,
             )
-            time.sleep(grace)
-        quiesce_deadline = time.monotonic() + max(0.0, quiesce_timeout)
+            _sleep(grace)
+        quiesce_deadline = _monotonic() + max(0.0, quiesce_timeout)
         settled = 0
-        while time.monotonic() < quiesce_deadline:
+        while _monotonic() < quiesce_deadline:
             if server.pools_idle():
                 settled += 1
                 if settled >= 3:  # idle across consecutive polls, not a gap
                     break
             else:
                 settled = 0
-            time.sleep(max(server.batch_timeout, 0.02))
+            _sleep(max(server.batch_timeout, 0.02))
         else:
             logger.warning(
                 "drain: pools still busy after %.1fs quiesce budget — "
@@ -631,7 +665,7 @@ def run_drain(
                 )
     finally:
         server._finish_drain()
-    summary["duration_s"] = round(time.monotonic() - t0, 3)
+    summary["duration_s"] = round(_monotonic() - t0, 3)
     logger.info(
         "drain complete in %.1fs: %d handed off, %d checkpointed, %d failed",
         summary["duration_s"], len(summary["handed_off"]),

@@ -20,7 +20,19 @@ This module makes those invariants *checked* instead of *hoped for*:
   record which locks were held when they were acquired; any cycle in
   that graph across the Runtime/host/loop threads is flagged as a
   deadlock hazard the moment the second edge appears, no actual
-  deadlock required.
+  deadlock required;
+- **quiesce-point audits** (ISSUE 14) — components register a callable
+  (:func:`register_quiesce_audit`) that returns the list of resource
+  leaks visible at a moment the component claims to be idle (gauge not
+  drained, slot/page accounting off baseline, refcounts not summing to
+  pool occupancy).  :func:`quiesce_point` runs the matching audits and
+  records each leak as a ``kind="quiesce"`` violation — surfaced in
+  :func:`summary` and failed by the conftest guard like any other
+  violation;
+- a **lock observer hook** (:func:`set_lock_observer`) — the lah-verify
+  interleaving explorer (analysis/verify.py) subscribes to tracked-lock
+  acquire/release events to learn each operation's shared-site
+  footprint for DPOR-style pruning.
 
 Everything is gated on ``LAH_SANITIZE=1`` **at import time**: with the
 flag off (production), :func:`runs_on` returns the function unchanged and
@@ -45,6 +57,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from contextlib import contextmanager
 from typing import Callable, Optional
 
@@ -303,16 +316,95 @@ def summary() -> dict:
             n for (kind, _), n in _violation_counts.items()
             if kind == "lock-cycle"
         )
+        quiesce = sum(
+            n for (kind, _), n in _violation_counts.items()
+            if kind == "quiesce"
+        )
         return {
             "enabled": _ENABLED,
             "thread_violations": thread_v,
             "lock_cycles": cycles,
+            "quiesce_leaks": quiesce,
             "violations_dropped": _violations_dropped,
             "lock_edges": len(_lock_edges),
             "stalls": _stalls["count"],
             "max_stall_ms": round(_stalls["max_ms"], 2),
             "sites": len({site for site, _ in _site_counts}),
         }
+
+
+# --------------------------------------------------------------------------
+# quiesce-point audits: resource-leak checks at claimed-idle moments
+# --------------------------------------------------------------------------
+
+# site -> audit callable (or weakref.WeakMethod for bound methods, so a
+# registered component can be garbage-collected without unregistering —
+# the same lifetime discipline as metrics collectors)
+_quiesce_audits: dict[str, object] = {}
+
+
+def register_quiesce_audit(site: str, fn: Callable[[], list]) -> None:
+    """Register ``fn`` to run at matching :func:`quiesce_point` calls.
+    ``fn`` returns a list of leak descriptions (empty = clean).  Bound
+    methods are held weakly; a dead referent unregisters itself.  No-op
+    with the sanitizer disabled (zero production cost)."""
+    if not _ENABLED:
+        return
+    ref: object = fn
+    if hasattr(fn, "__self__"):
+        ref = weakref.WeakMethod(fn)
+    with _state_lock:
+        if len(_quiesce_audits) > 64:
+            # high-churn registrants (the lah-verify explorer builds
+            # hundreds of short-lived schedulers) leave dead WeakMethods
+            # behind; sweep them here so the registry stays bounded
+            for k in [
+                k for k, r in _quiesce_audits.items()
+                if isinstance(r, weakref.WeakMethod) and r() is None
+            ]:
+                del _quiesce_audits[k]
+        _quiesce_audits[site] = ref
+
+
+def unregister_quiesce_audit(site: str) -> None:
+    with _state_lock:
+        _quiesce_audits.pop(site, None)
+
+
+def quiesce_point(prefix: str = "") -> list[str]:
+    """Run every registered audit whose site starts with ``prefix`` (all
+    of them for "").  Each returned leak is recorded as a ``quiesce``
+    violation at that site and the combined list is returned.  An audit
+    that raises is itself a finding — a leak checker that cannot run is
+    not a clean bill."""
+    if not _ENABLED:
+        return []
+    with _state_lock:
+        matched = [
+            (site, ref) for site, ref in _quiesce_audits.items()
+            if site.startswith(prefix)
+        ]
+    leaks: list[str] = []
+    dead: list[str] = []
+    for site, ref in matched:
+        fn = ref
+        if isinstance(ref, weakref.WeakMethod):
+            fn = ref()
+            if fn is None:
+                dead.append(site)
+                continue
+        try:
+            found = list(fn() or [])
+        except Exception as e:  # the audit itself failing is a finding
+            found = [f"audit raised {type(e).__name__}: {e}"]
+        for leak in found:
+            _record_violation("quiesce", site, leak)
+            leaks.append(f"{site}: {leak}")
+    if dead:
+        with _state_lock:
+            for site in dead:
+                _quiesce_audits.pop(site, None)
+    return leaks
 
 
 # --------------------------------------------------------------------------
@@ -382,6 +474,26 @@ def lock_edges() -> dict:
         return dict(_lock_edges)
 
 
+# Optional subscriber for tracked-lock events.  The lah-verify
+# interleaving explorer (analysis/verify.py) sets this to learn each
+# operation's shared-site footprint — which named locks an op touches —
+# for DPOR-style pruning (only ops with intersecting footprints are
+# worth permuting).  Called as fn("acquire"|"release", lock_name) AFTER
+# a successful acquire / BEFORE the underlying release.  Must be cheap
+# and must not touch tracked locks itself (reentrancy).
+_lock_observer: Optional[Callable[[str, str], None]] = None
+
+
+def set_lock_observer(fn: Callable[[str, str], None]) -> None:
+    global _lock_observer
+    _lock_observer = fn
+
+
+def clear_lock_observer() -> None:
+    global _lock_observer
+    _lock_observer = None
+
+
 class _TrackedLock:
     """A named lock whose acquisitions feed the ordering graph."""
 
@@ -399,6 +511,9 @@ class _TrackedLock:
         got = self._real.acquire(blocking, timeout)
         if got:
             held.append((self.name, me))
+            obs = _lock_observer
+            if obs is not None:
+                obs("acquire", self.name)
         return got
 
     def release(self) -> None:
@@ -410,6 +525,9 @@ class _TrackedLock:
                 if held[i] == me:
                     del held[i]
                     break
+        obs = _lock_observer
+        if obs is not None:
+            obs("release", self.name)
         self._real.release()
 
     def locked(self) -> bool:

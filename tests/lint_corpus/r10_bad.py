@@ -1,0 +1,13 @@
+"""R10 corpus: one tracked lock with no CONCURRENCY.md rank row, plus a
+rank-inverted nesting of two documented locks (must fire twice)."""
+from learning_at_home_tpu.utils import sanitizer
+
+_rogue = sanitizer.lock("zz.not.in.the.table")
+
+
+def inverted():
+    # trainer.apply (rank 30) held while taking moe.sessions (rank 25):
+    # ranks must strictly increase inward
+    with sanitizer.lock("trainer.apply"):
+        with sanitizer.lock("moe.sessions"):
+            return 1

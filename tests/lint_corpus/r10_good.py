@@ -1,0 +1,15 @@
+"""R10 corpus: documented lock names nested in increasing rank order
+(must be clean)."""
+from learning_at_home_tpu.utils import sanitizer
+
+
+class Registry:
+    def __init__(self):
+        self._lock = sanitizer.lock("client.rpc.state")
+
+    def snapshot(self):
+        # client.rpc.state (rank 10) -> moe.sessions (rank 25): inward
+        # ranks strictly increase
+        with self._lock:
+            with sanitizer.lock("moe.sessions"):
+                return 1

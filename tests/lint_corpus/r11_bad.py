@@ -1,0 +1,15 @@
+"""R11 corpus: an @runs_on hot path calls a helper that acquires a
+tracked lock without carrying its own @runs_on assertion (must fire)."""
+from learning_at_home_tpu.utils import sanitizer
+
+_lock = sanitizer.lock("client.rpc.state")
+
+
+def _mutate_registry():
+    with _lock:
+        return 1
+
+
+@sanitizer.runs_on("host", site="corpus.r11.hot_path")
+def hot_path():
+    return _mutate_registry()

@@ -1,0 +1,16 @@
+"""R11 corpus: the lock-acquiring helper on the hot path carries its
+own @runs_on assertion (must be clean)."""
+from learning_at_home_tpu.utils import sanitizer
+
+_lock = sanitizer.lock("client.rpc.state")
+
+
+@sanitizer.runs_on("host", site="corpus.r11.helper")
+def _mutate_registry():
+    with _lock:
+        return 1
+
+
+@sanitizer.runs_on("host", site="corpus.r11.hot_path")
+def hot_path():
+    return _mutate_registry()

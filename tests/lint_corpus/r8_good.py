@@ -1,0 +1,11 @@
+"""R8 corpus: every handled wire op is documented (must be clean) —
+``forward``/``info`` sit in PROTOCOL.md op tables, ``hello`` is the
+prose-documented handshake."""
+
+
+async def _dispatch(msg_type, meta, tensors):
+    if msg_type == "hello":
+        return {"ok": True}
+    if msg_type in ("forward", "info"):
+        return {"ok": True}
+    raise ValueError(f"unknown op {msg_type}")

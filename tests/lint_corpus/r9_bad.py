@@ -1,0 +1,6 @@
+"""R9 corpus: a headline metric literal missing from the
+OBSERVABILITY.md catalog (must fire)."""
+
+
+def collect() -> dict:
+    return {"lah_zz_bogus_widget_total": 1}

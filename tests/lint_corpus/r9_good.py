@@ -1,0 +1,9 @@
+"""R9 corpus: catalogued metric names only (must be clean) — both are
+verbatim OBSERVABILITY.md entries."""
+
+
+def collect() -> dict:
+    return {
+        "lah_gateway_kv_pages_total": 4,
+        "lah_server_draining": 0,
+    }

@@ -1,0 +1,205 @@
+"""Self-tests for lah-verify (ISSUE 14): the interleaving explorer must
+RE-FIND both mechanically re-introduced PR-13 scheduler races — the same
+way a linter must fire on its bad corpus — and must explore the merged
+tree clean.  Plus the sanitizer's quiesce-point resource audits: leaks
+at claimed-idle moments are findings, drained here via
+``expect_violations`` so the conftest guard stays green."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from learning_at_home_tpu.analysis import verify
+from learning_at_home_tpu.utils import sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SMOKE_BUDGET = 40  # schedules per world: seconds, not minutes, in CI
+
+
+# ---------------------------------------------------------------------------
+# explorer: merged tree clean, seeded bugs re-found, determinism
+# ---------------------------------------------------------------------------
+
+
+def test_merged_tree_explores_clean():
+    r = verify.explore_gateway(max_schedules=SMOKE_BUDGET)
+    assert r.clean, "\n".join(str(v) for v in r.violations)
+    assert r.schedules_run > 1, "explorer degenerated to a single schedule"
+
+
+def test_merged_tree_clean_with_cancel_and_prefix_cache():
+    for kw in ({"with_cancel": True}, {"prefix_cache": True}):
+        r = verify.explore_gateway(max_schedules=SMOKE_BUDGET, **kw)
+        assert r.clean, "\n".join(str(v) for v in r.violations)
+
+
+def test_seeded_stale_prefill_is_refound():
+    r = verify.explore_gateway(
+        max_schedules=200, seeded_bug="stale-prefill"
+    )
+    assert r.violations, (
+        "the PR-13 stale-snapshot revert was NOT re-found — the checker "
+        "regressed"
+    )
+
+
+def test_seeded_mutual_preemption_is_refound():
+    r = verify.explore_gateway(
+        max_schedules=200, seeded_bug="mutual-preemption"
+    )
+    assert r.violations, (
+        "the PR-13 exclude-the-raiser livelock revert was NOT re-found "
+        "— the checker regressed"
+    )
+    details = " ".join(v.detail for v in r.violations)
+    assert "preempt" in details or "stuck" in details
+
+
+def test_seeded_bug_detection_is_deterministic():
+    """Same seed => same first failing interleaving, twice over."""
+    a = verify.explore_gateway(max_schedules=200,
+                               seeded_bug="stale-prefill", seed=3)
+    b = verify.explore_gateway(max_schedules=200,
+                               seeded_bug="stale-prefill", seed=3)
+    assert a.violations and b.violations
+    assert a.violations[0].trace == b.violations[0].trace
+    assert a.violations[0].schedule_index == b.violations[0].schedule_index
+
+
+def test_unknown_seeded_bug_rejected():
+    with pytest.raises(ValueError):
+        verify._GatewayWorld(seeded_bug="nonsense").close()
+
+
+def test_lifecycle_and_receiver_worlds_clean():
+    r = verify.explore_lifecycle(max_schedules=SMOKE_BUDGET)
+    assert r.clean, "\n".join(str(v) for v in r.violations)
+    rr = verify.check_handoff_receiver()
+    assert rr.clean, "\n".join(str(v) for v in rr.violations)
+
+
+def test_collect_invariants_covers_every_module():
+    rows = verify.collect_invariants()
+    names = {n for n, _, _ in rows}
+    prefixes = {n.split(".", 1)[0] for n in names}
+    assert {"scheduler", "kv", "lifecycle"} <= prefixes
+    assert len(rows) == len(names), "duplicate invariant names"
+
+
+def test_virtual_clock_restored_after_exploration():
+    from learning_at_home_tpu.gateway import scheduler as sched_mod
+
+    before = sched_mod._monotonic
+    verify.explore_gateway(max_schedules=2)
+    assert sched_mod._monotonic is before
+    # and the seam really is the process clock again
+    assert abs(sched_mod._monotonic() - time.monotonic()) < 5.0
+
+
+def test_smoke_cli_under_a_minute():
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lah_verify.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 violation(s)" in r.stdout
+    assert "stale-prefill FOUND" in r.stdout
+    assert "mutual-preemption FOUND" in r.stdout
+    assert elapsed < 60, f"smoke took {elapsed:.1f}s — too slow for CI"
+
+
+def test_cli_list_invariants():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lah_verify.py"),
+         "--list-invariants"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    assert "scheduler.slot_unique" in r.stdout
+    assert "lifecycle.drain_no_abort" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# quiesce-point resource audits (sanitizer side)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not sanitizer.enabled(), reason="needs LAH_SANITIZE=1")
+def test_quiesce_point_reports_registered_leaks():
+    leaks_to_report = ["widget left open"]
+    sanitizer.register_quiesce_audit(
+        "test.quiesce.demo", lambda: list(leaks_to_report)
+    )
+    try:
+        with sanitizer.expect_violations("test.quiesce.demo") as seen:
+            found = sanitizer.quiesce_point("test.quiesce.")
+        assert found == ["test.quiesce.demo: widget left open"]
+        assert [v["kind"] for v in seen] == ["quiesce"]
+        # clean audits stay silent
+        leaks_to_report.clear()
+        assert sanitizer.quiesce_point("test.quiesce.") == []
+    finally:
+        sanitizer.unregister_quiesce_audit("test.quiesce.demo")
+
+
+@pytest.mark.skipif(not sanitizer.enabled(), reason="needs LAH_SANITIZE=1")
+def test_quiesce_point_audit_exception_is_a_finding():
+    def broken():
+        raise RuntimeError("audit infra died")
+
+    sanitizer.register_quiesce_audit("test.quiesce.broken", broken)
+    try:
+        with sanitizer.expect_violations("test.quiesce.broken") as seen:
+            found = sanitizer.quiesce_point("test.quiesce.broken")
+        assert found and "audit raised RuntimeError" in found[0]
+        assert seen
+    finally:
+        sanitizer.unregister_quiesce_audit("test.quiesce.broken")
+
+
+@pytest.mark.skipif(not sanitizer.enabled(), reason="needs LAH_SANITIZE=1")
+def test_scheduler_quiesce_audit_trips_on_leaked_slot():
+    """A decoder slot held while the stream table is empty is exactly
+    the leak the scheduler's claimed-idle audit exists to catch."""
+    from learning_at_home_tpu.gateway.scheduler import SlotScheduler
+
+    dec = verify._FakePagedDecoder()
+    sched = SlotScheduler(dec, idle_wait_s=0.0, stream_ttl_s=1000.0,
+                          prefill_chunk_tokens=2)
+    # leak: the decoder claims a slot the scheduler never learns about
+    dec.begin_prefill(0, [1, 2, 3], stream_id="ghost")
+    with sanitizer.expect_violations("gateway.scheduler.") as seen:
+        found = sanitizer.quiesce_point(sched._quiesce_site)
+    assert found, "leaked slot not reported at the quiesce point"
+    assert any("quiesce_baseline" in v["detail"] for v in seen)
+    dec.evict(0)
+    assert sanitizer.quiesce_point(sched._quiesce_site) == []
+
+
+@pytest.mark.skipif(not sanitizer.enabled(), reason="needs LAH_SANITIZE=1")
+def test_scheduler_quiesce_audit_silent_while_busy():
+    """Mid-work states are NOT leaks — the audit only bites at idle."""
+    from learning_at_home_tpu.gateway.scheduler import SlotScheduler
+
+    dec = verify._FakePagedDecoder()
+    sched = SlotScheduler(dec, idle_wait_s=0.0, stream_ttl_s=1000.0,
+                          prefill_chunk_tokens=2)
+    sched.submit([1, 2, 3], 2)  # pending, never scheduled
+    assert sanitizer.quiesce_point(sched._quiesce_site) == []
+
+
+def test_kv_audit_trips_on_seeded_refcount_corruption():
+    dec = verify._FakePagedDecoder()
+    assert dec.kv.audit() == []
+    dec.kv.refcount[1] = 5  # nobody maps page 1
+    leaks = dec.kv.audit()
+    assert leaks, "seeded refcount corruption not detected"
+    assert any("refcount" in leak for leak in leaks)

@@ -4,8 +4,13 @@ imports (e.g. a jax API moved between releases, like the ``jax.shard_map``
 regression) instead of surfacing as tier-1 collection errors minutes in.
 
 Stage 0 is the LINT GATE (ISSUE 6): ``lah_lint`` runs over the package
-(pure AST, sub-second) and any non-baselined R1-R7 finding fails the
-gate before a single test collects.  Then ``pytest --collect-only`` on
+(pure AST, sub-second) and any non-baselined R1-R11 finding fails the
+gate before a single test collects.  Stage 0.5 is the VERIFY GATE
+(ISSUE 14): ``lah_verify --smoke`` explores the gateway scheduler,
+drain lifecycle, and handoff receiver under permuted operation orders
+— any invariant violation fails the gate (rc=6), and so does the
+seeded-bug self-validation (the explorer must still re-find both PR-13
+races, deterministically).  Then ``pytest --collect-only`` on
 CPU exits non-zero on any collection error, then a CLIENT-PATH SMOKE:
 one forward+backward RPC against a local server under BOTH wire
 protocols (legacy/v1 and pipelined/v2), so wire-format breakage fails
@@ -33,8 +38,9 @@ The tier-1 pytest run itself executes under the concurrency sanitizer
 edge count) at session end; set ``LAH_SANITIZE_SUMMARY=<path>`` to also
 export it as JSON, which this gate prints when present.
 
-``--lint`` runs ONLY the lint stage; ``--no-smoke`` skips the RPC smoke;
-``--smoke-worker`` is the internal child mode that actually runs it.
+``--lint`` runs ONLY the lint stage; ``--verify`` runs ONLY the lint +
+verify stages; ``--no-smoke`` skips the RPC smoke; ``--smoke-worker``
+is the internal child mode that actually runs it.
 """
 
 import os
@@ -105,6 +111,39 @@ def lint_stage() -> int:
                 print(f"collect_gate: sanitizer summary — {fh.read().strip()}")
         except OSError:
             pass
+    return 0
+
+
+def verify_stage() -> int:
+    """Stage 0.5: ``lah_verify --smoke`` (ISSUE 14) — deterministic
+    interleaving exploration of the gateway scheduler / drain lifecycle
+    / handoff receiver plus the seeded-bug self-validation, in a
+    subprocess so the virtual-clock patching can never leak into this
+    process.  LAH_SANITIZE=1 arms the lock-footprint observer the
+    explorer's commutativity pruning feeds on (sound either way, just
+    slower without it).  Fails (rc=6) on any invariant violation or if
+    a seeded PR-13 race is no longer re-found."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("LAH_SANITIZE", "1")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lah_verify.py"),
+             "--smoke"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=int(os.environ.get("COLLECT_GATE_VERIFY_TIMEOUT_S",
+                                       "120")),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: lah_verify timed out", file=sys.stderr)
+        return 6
+    if r.returncode != 0:
+        print("collect_gate: FAIL — lah_verify:", file=sys.stderr)
+        print(r.stdout[-2000:], file=sys.stderr)
+        print(r.stderr[-1000:], file=sys.stderr)
+        return 6
+    tail = (r.stdout or "").strip().splitlines()
+    print(f"collect_gate: verify OK — {tail[-1] if tail else ''}")
     return 0
 
 
@@ -875,6 +914,11 @@ def main() -> int:
     if rc:
         return rc
     if "--lint" in sys.argv:
+        return 0
+    rc = verify_stage()  # stage 0.5: interleaving exploration, seconds
+    if rc:
+        return rc
+    if "--verify" in sys.argv:
         return 0
     rc = orphan_guard()  # BEFORE any timing work (smokes spawn servers)
     if rc:

@@ -7,10 +7,13 @@ Default path is ``learning_at_home_tpu/``.  Exit codes: 0 = clean (all
 findings baselined with ``# lah-lint: ignore[Rn]`` annotations or none
 at all), 1 = unsuppressed findings, 2 = parse failure in a linted file.
 
-Rules (R1-R7) and the suppression contract are documented in
-``learning_at_home_tpu/analysis/lint.py`` and docs/CONCURRENCY.md.
-Runs pure-AST — no jax import, sub-second — so it sits in front of the
-collect gate (tools/collect_gate.py --lint).
+Rules (R1-R11) and the suppression contract are documented in
+``learning_at_home_tpu/analysis/lint.py`` and docs/CONCURRENCY.md;
+R8-R11 cross-check the code against the spec docs themselves
+(PROTOCOL.md op tables, OBSERVABILITY.md metric catalog, the
+CONCURRENCY.md lock-rank table).  Runs pure-AST — no jax import,
+sub-second — so it sits in front of the collect gate
+(tools/collect_gate.py --lint).
 """
 
 import argparse
