@@ -233,7 +233,7 @@ def run_dht_sim_bench(deadline: int = 420, sizes: str = "128,512") -> dict | Non
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "5c5b273"
+PREV_ROUND_REV = "7e6b0cf"
 
 
 def check_orphan_servers() -> dict | None:

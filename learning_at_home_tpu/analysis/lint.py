@@ -67,6 +67,37 @@ trees) R8–R10 skip rather than guess:
   thread-ownership claims must cover the whole reachable hot path, not
   just its entry point.
 
+Wire-contract conformance rules (ISSUE 15) run the analysis/schema.py
+extractor over the linted set and check the SENDER-side message
+construction against the HANDLER-side parse sites — the version-skew
+and shape-drift classes PR 11 could only document by hand.  They
+evaluate only where both sides are visible (cross-module; lint the
+package root for the real verdict):
+
+- **R12**  every meta field a sender emits for an op must be parsed by
+  at least one handler of that op (``meta["f"]`` or ``meta.get("f")``)
+  — an unparsed field is dead weight on every frame or, worse, a
+  misspelled one the handler silently defaults.
+- **R13**  every field a handler hard-requires (subscript access) must
+  be guaranteed on EVERY sender construction path for that op —
+  including retry/fallback/legacy branches — or an old or partial
+  client turns into a server-side KeyError.
+- **R14**  feature-gated wire forms may only be emitted under their
+  negotiation guard: the dict ``wire`` codec form needs a dominating
+  ``pool.supports("codec")`` test (legacy string dtypes are exempt);
+  ``pack_frames(..., rid=...)`` outside the rid-echo /
+  ``mux.next_rid()`` / ``peek_header`` idioms tags frames v1 peers
+  never negotiated.  This is the mixed-build skew class as a rule.
+- **R15**  PROTOCOL.md's machine-read field rows (``| field | op |
+  kind | type | gate |`` tables) must match the extracted handler IR
+  exactly — field set and required/optional kind both directions — so
+  wire/doc drift fails the gate like lock-rank drift does.
+
+R12–R15 suppressions additionally REQUIRE a written reason (text after
+the ``ignore[...]`` bracket, or explanatory lines in the surrounding
+comment block): a wire-contract asymmetry without a recorded why is a
+bug, not a baseline.
+
 R3 (gateway extension, ISSUE 14): gateway/handoff bounded-concurrency
 constants — ``MAX_*SESSIONS`` class/module ints, ``*DEFAULT_PREFILL_
 CHUNK`` module ints, and integer-literal env fallbacks for
@@ -105,9 +136,16 @@ RULES = {
     "R9": "metric name not in the OBSERVABILITY.md catalog",
     "R10": "sanitizer lock name missing from CONCURRENCY.md lock table or nested against its rank",
     "R11": "lock-acquiring function on a @runs_on hot path without its own @runs_on",
+    "R12": "sender-emitted meta field no handler of that op parses",
+    "R13": "handler-required meta field not guaranteed on every sender path",
+    "R14": "feature-gated wire form emitted without its negotiation guard",
+    "R15": "PROTOCOL.md field rows out of sync with the handler schema",
 }
 
 _SUPPRESS_RE = re.compile(r"lah-lint:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+# wire-contract suppressions must carry a written reason (see docstring)
+_REASON_REQUIRED = {"R12", "R13", "R14", "R15"}
 
 # R1 canonical blocking callables (after import-alias resolution)
 _BLOCKING_CALLS = {
@@ -176,17 +214,32 @@ def _dotted(node: ast.AST, aliases: dict) -> Optional[str]:
     return None
 
 
-def _suppressions(source: str) -> dict[int, set]:
-    """line -> rule-ids suppressed there.  A suppression comment covers
-    its own line; a comment-only line covers the next CODE line (comment
-    blocks pass through — the marker may sit anywhere in a multi-line
-    explanation above the finding)."""
-    out: dict[int, set] = {}
+def _suppressions(source: str) -> dict[int, dict]:
+    """line -> {rule-id: has_written_reason} suppressed there.  A
+    suppression comment covers its own line; a comment-only line covers
+    the next CODE line (comment blocks pass through — the marker may sit
+    anywhere in a multi-line explanation above the finding).
+
+    ``has_written_reason`` is True when text follows the ``ignore[...]``
+    bracket, or the marker sits in a comment block with other
+    explanatory comment lines; rules in ``_REASON_REQUIRED`` only
+    suppress with a reason."""
+    out: dict[int, dict] = {}
     lines = source.splitlines()
 
     def _is_comment_or_blank(idx0: int) -> bool:
         s = lines[idx0].strip() if idx0 < len(lines) else ""
         return not s or s.startswith("#")
+
+    def _is_comment(idx0: int) -> bool:
+        return (
+            0 <= idx0 < len(lines) and lines[idx0].strip().startswith("#")
+        )
+
+    def _put(line: int, rules: set, reasoned: bool) -> None:
+        slot = out.setdefault(line, {})
+        for r in rules:
+            slot[r] = slot.get(r, False) or reasoned
 
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
@@ -198,12 +251,28 @@ def _suppressions(source: str) -> dict[int, set]:
                 continue
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
             line = tok.start[0]
-            out.setdefault(line, set()).update(rules)
-            if tok.line.strip().startswith("#"):  # standalone comment line
+            reasoned = bool(tok.string[m.end():].strip(" \t:—–-#"))
+            standalone = tok.line.strip().startswith("#")
+            if standalone and not reasoned:
+                # multi-line explanation: any OTHER text-bearing comment
+                # line in the contiguous block counts as the reason
+                for idx0 in range(line - 2, -1, -1):  # lines above
+                    if not _is_comment(idx0):
+                        break
+                    if lines[idx0].strip().lstrip("#").strip():
+                        reasoned = True
+                        break
+                idx0 = line  # lines below (0-based `line` IS the next line)
+                while not reasoned and _is_comment(idx0):
+                    if lines[idx0].strip().lstrip("#").strip():
+                        reasoned = True
+                    idx0 += 1
+            _put(line, rules, reasoned)
+            if standalone:
                 nxt = line  # 1-based; lines[nxt] is the NEXT line (0-based)
                 while nxt < len(lines) and _is_comment_or_blank(nxt):
                     nxt += 1
-                out.setdefault(nxt + 1, set()).update(rules)
+                _put(nxt + 1, rules, reasoned)
     except tokenize.TokenError:
         pass
     return out
@@ -636,6 +705,7 @@ def _doc_corpus(docs_dir: str) -> dict:
         "protocol_path": os.path.join(docs_dir, "PROTOCOL.md"),
         "concurrency_path": os.path.join(docs_dir, "CONCURRENCY.md"),
         "ops": {},  # op name -> PROTOCOL.md line of its table row
+        "fields": {},  # op key -> {field: {kind, types, gate, line}} (R15)
         "metric_tokens": set(),
         "metric_families": [],
         "have_observability": False,
@@ -643,23 +713,61 @@ def _doc_corpus(docs_dir: str) -> dict:
         "have_concurrency": False,
     }
     # PROTOCOL.md op tables: rows whose first cell is a backticked name,
-    # under a table header whose first cell is "type"
+    # under a table header whose first cell is "type".  Field tables
+    # (R15) use a "field" first header cell: | field | op | kind | type
+    # | gate |; the op cell holds `op`, `op@family` or `*@family`, and a
+    # literal (none) field cell declares an op with no op-specific
+    # fields (registers the op key for coverage).
     try:
         with open(corpus["protocol_path"], encoding="utf-8") as fh:
             in_op_table = False
+            in_field_table = False
             for lineno, raw in enumerate(fh, 1):
                 s = raw.strip()
                 if not s.startswith("|"):
                     in_op_table = False
+                    in_field_table = False
                     continue
                 cells = [c.strip() for c in s.strip("|").split("|")]
                 if cells and cells[0] == "type":
                     in_op_table = True
                     continue
+                if cells and cells[0] == "field":
+                    in_field_table = True
+                    continue
                 if in_op_table and cells:
                     m = re.fullmatch(r"`([a-z][a-z0-9_]*)`", cells[0])
                     if m:
                         corpus["ops"].setdefault(m.group(1), lineno)
+                if in_field_table and len(cells) >= 3:
+                    mo = re.fullmatch(
+                        r"`([a-z_*][a-z0-9_]*(?:@[a-z_]+)?)`", cells[1]
+                    )
+                    if mo is None:
+                        continue
+                    opkey = mo.group(1)
+                    rows = corpus["fields"].setdefault(opkey, {})
+                    mf = re.fullmatch(r"`([a-z_][a-z0-9_]*)`", cells[0])
+                    if mf is None:
+                        continue  # (none) / separator: op key registered
+                    kind = (
+                        "req" if cells[2].lower().startswith("req")
+                        else "opt"
+                    )
+                    types = tuple(
+                        t for t in re.findall(
+                            r"[a-z]+", cells[3].split("[")[0]
+                        )
+                    ) if len(cells) > 3 else ()
+                    gate = None
+                    if len(cells) > 4:
+                        mg = re.fullmatch(r"`([a-z]+)`", cells[4])
+                        if mg:
+                            gate = mg.group(1)
+                    rows[mf.group(1)] = {
+                        "kind": kind, "types": types, "gate": gate,
+                        "line": lineno,
+                    }
     except OSError:
         pass
     # OBSERVABILITY.md: every backticked token (label suffixes like
@@ -880,6 +988,180 @@ def _r11_findings(
     return findings
 
 
+# ---------------------------------------------------------------------------
+# R12–R15: wire-contract conformance (analysis/schema.py IR)
+# ---------------------------------------------------------------------------
+
+
+def _doc_rows_for(corpus: dict, op: str, family: str) -> Optional[dict]:
+    """Merged field rows for an op: family-common ``*@family`` rows
+    overlaid by the per-op rows (qualified ``op@family`` wins over the
+    bare op key).  None when the docs carry no rows for the op at all —
+    including no family rows and no ``(none)`` marker."""
+    fields = corpus.get("fields", {})
+    per_op = fields.get(f"{op}@{family}")
+    if per_op is None:
+        per_op = fields.get(op)
+    fam = fields.get(f"*@{family}")
+    if per_op is None and fam is None:
+        return None
+    merged = dict(fam or {})
+    merged.update(per_op or {})
+    return merged
+
+
+def _wire_conformance_findings(py_files: list[str]) -> list[Finding]:
+    from . import schema as _schema
+
+    ir = _schema.extract(py_files)
+    findings: list[Finding] = []
+    if not ir.handlers and not ir.gate_candidates:
+        return findings
+
+    # R12: every sender-emitted field must be parsed by some handler of
+    # the op (evaluated only when a handler of the op is in the set)
+    for site in ir.senders:
+        handlers = [h for h in ir.handlers if site.op in h.ops]
+        if not handlers:
+            continue
+        accepted: set = set()
+        for h in handlers:
+            accepted.update(h.accepted(site.op))
+        for name, fld in sorted(site.fields.items()):
+            if name not in accepted:
+                findings.append(
+                    Finding(
+                        site.path, fld.line or site.line, 0, "R12",
+                        f"sender emits meta field `{name}` for op "
+                        f"`{site.op}` but no handler of that op parses "
+                        f"it (accepted: {sorted(accepted)})",
+                    )
+                )
+
+    # R13: handler-required fields must be guaranteed on every sender
+    # construction path.  For multi-family ops only fields EVERY family
+    # requires are checked (a family-specific requirement cannot bind
+    # senders addressing the other family).
+    for op in sorted(ir.handled_ops()):
+        required: Optional[set] = None
+        for h in ir.handlers:
+            if op not in h.ops:
+                continue
+            req = {
+                name for name, use in h.accepted(op).items()
+                if use.kind == "req"
+            }
+            required = req if required is None else (required & req)
+        if not required:
+            continue
+        for site in ir.sender_sites(op):
+            for name in sorted(required):
+                fld = site.fields.get(name)
+                if fld is None or fld.kind != "req":
+                    how = (
+                        "only conditionally" if fld is not None
+                        else "never"
+                    )
+                    findings.append(
+                        Finding(
+                            site.path, site.line, 0, "R13",
+                            f"handler of op `{op}` hard-requires meta "
+                            f"field `{name}` (subscript access) but this "
+                            f"construction path sets it {how}",
+                        )
+                    )
+
+    # R14: ungated feature-dependent wire forms found by the extractor
+    for cand in ir.gate_candidates:
+        findings.append(
+            Finding(
+                cand.path, cand.line, cand.col, "R14",
+                f"feature-gated `{cand.what}` form: {cand.detail}",
+            )
+        )
+
+    # R15: handler IR vs the PROTOCOL.md machine-read field rows.  Ops
+    # absent from the op tables entirely are R8's finding, not ours;
+    # docs without any field tables leave the rule inert (pre-ISSUE-15
+    # corpora).
+    for h in ir.handlers:
+        docs_dir = _find_docs_dir(h.path)
+        if docs_dir is None:
+            continue
+        corpus = _doc_corpus(docs_dir)
+        if not corpus.get("fields"):
+            continue
+        for op in sorted(h.ops):
+            if op in _R8_HANDSHAKE_OPS or op not in corpus["ops"]:
+                continue
+            doc_fields = _doc_rows_for(corpus, op, h.family)
+            op_line = h.op_lines.get(op, 0)
+            if doc_fields is None:
+                findings.append(
+                    Finding(
+                        h.path, op_line, 0, "R15",
+                        f"op `{op}` ({h.family}) has no machine-read "
+                        "field rows in PROTOCOL.md — add a | field | op "
+                        "| kind | ... | row per field (or a (none) row)",
+                    )
+                )
+                continue
+            code_fields = h.accepted(op)
+            for name, use in sorted(code_fields.items()):
+                if name not in doc_fields:
+                    findings.append(
+                        Finding(
+                            h.path, use.line or op_line, 0, "R15",
+                            f"op `{op}` ({h.family}) parses meta field "
+                            f"`{name}` but PROTOCOL.md has no field row "
+                            "for it",
+                        )
+                    )
+            sites = ir.sender_sites(op)
+            for name, row in sorted(doc_fields.items()):
+                use = code_fields.get(name)
+                if use is None:
+                    findings.append(
+                        Finding(
+                            h.path, op_line, 0, "R15",
+                            f"PROTOCOL.md documents field `{name}` for "
+                            f"op `{op}` ({h.family}) but the handler "
+                            "never parses it (stale row or missing "
+                            "parse)",
+                        )
+                    )
+                    continue
+                if use.kind == "req" and row["kind"] != "req":
+                    findings.append(
+                        Finding(
+                            h.path, use.line or op_line, 0, "R15",
+                            f"op `{op}` ({h.family}): handler "
+                            f"hard-requires `{name}` but PROTOCOL.md "
+                            "documents it optional",
+                        )
+                    )
+                elif row["kind"] == "req" and use.kind != "req":
+                    # a doc-required field the handler reads softly is
+                    # honored when every in-set sender guarantees it (the
+                    # handler validates dynamically); senderless ops
+                    # trust the handler's own validation
+                    if sites and any(
+                        name not in s.fields
+                        or s.fields[name].kind != "req"
+                        for s in sites
+                    ):
+                        findings.append(
+                            Finding(
+                                h.path, use.line or op_line, 0, "R15",
+                                f"op `{op}` ({h.family}): PROTOCOL.md "
+                                f"documents `{name}` required but some "
+                                "sender path does not guarantee it "
+                                "(doc row or sender is wrong)",
+                            )
+                        )
+    return findings
+
+
 def _iter_py_files(paths: Iterable[str]) -> list[str]:
     out: list[str] = []
     for p in paths:
@@ -901,8 +1183,9 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
     verdict."""
     findings: list[Finding] = []
     all_facts: list[tuple[str, _ModuleFacts]] = []
-    suppress_by_path: dict[str, dict[int, set]] = {}
-    for path in _iter_py_files(paths):
+    suppress_by_path: dict[str, dict[int, dict]] = {}
+    py_files = _iter_py_files(paths)
+    for path in py_files:
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 source = fh.read()
@@ -1075,11 +1358,22 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
                     )
                 )
 
-    # apply suppressions
+    # R12–R15: wire-contract conformance over the schema IR (both sides
+    # must be in the linted set; doc-less trees skip R15 like R8–R10)
+    findings.extend(_wire_conformance_findings(py_files))
+
+    # apply suppressions (R12–R15 demand a written reason — see
+    # _suppressions; an unreasoned marker does not baseline them)
     for f in findings:
-        rules = suppress_by_path.get(f.path, {}).get(f.line, set())
+        rules = suppress_by_path.get(f.path, {}).get(f.line, {})
         if f.rule in rules:
-            f.suppressed = True
+            if f.rule in _REASON_REQUIRED and not rules[f.rule]:
+                f.message += (
+                    " [suppression present but carries no written "
+                    "reason — wire-contract baselines must say why]"
+                )
+            else:
+                f.suppressed = True
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

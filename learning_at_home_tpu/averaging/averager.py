@@ -859,7 +859,12 @@ class DecentralizedAverager:
             off: int, n: int, wire: WireTensors, wmeta, raw
         ) -> None:
             meta = {
-                "gid": group.gid, "part": part_index,
+                "gid": group.gid,
+                # `part` is a diagnostic partition index for peer logs
+                # and chaos traces; the receiver deliberately keys on
+                # gid/off/part_len only (PROTOCOL.md avg_part field rows)
+                # lah-lint: ignore[R12]
+                "part": part_index,
                 "sender": self.peer_id, "w": float(self.cfg.weight),
                 "off": off, "part_len": part_len,
             }

@@ -101,8 +101,15 @@ class AveragingPeerHandler:
                 except Exception:
                     msg_type, rid = None, None
                 if msg_type == "hello":
-                    _, _, hmeta = unpack_message(payload)
-                    offered = hmeta.get("features") or []
+                    # peer-supplied hello: non-map meta / non-list offer
+                    # negotiates the empty set, never a torn connection
+                    try:
+                        _, _, hmeta = unpack_message(payload)
+                        offered = hmeta.get("features")
+                    except Exception:
+                        offered = None
+                    if not isinstance(offered, list):
+                        offered = []
                     common = [f for f in AVERAGING_FEATURES if f in offered]
                     muxed = "mux" in common
                     await self._send(

@@ -1678,6 +1678,11 @@ class RemoteMixtureOfExperts:
             if prepared is not None:
                 wire_obj, wmeta = prepared[uid]
                 if wmeta is not None:
+                    # wmeta is built per-endpoint by the adaptive codec
+                    # selector, which only offers encoded (dict) forms to
+                    # pools whose hello negotiated "codec" — the gate is
+                    # upstream of this function, out of static reach
+                    # lah-lint: ignore[R14]
                     meta["wire"] = wmeta
                 tensors, _ = await pool.rpc_prepared(
                     msg_type, wire_obj, meta, timeout=rpc_timeout
@@ -1733,6 +1738,11 @@ class RemoteMixtureOfExperts:
                         ],
                     }
                 if wmeta is not None:
+                    # same contract as call_single: the codec selector
+                    # only prepares dict wire forms for endpoints whose
+                    # hello negotiated "codec", so the supports() gate
+                    # sits upstream of this merged-call path
+                    # lah-lint: ignore[R14]
                     multi_meta["wire"] = wmeta
                 reply_tensors, reply_meta = await pool.rpc_prepared(
                     "multi", wire, multi_meta, timeout=rpc_timeout

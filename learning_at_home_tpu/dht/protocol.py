@@ -170,20 +170,48 @@ class DHTProtocol:
                     payload = await recv_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break
-                msg_type, rid = peek_header(payload)
-                _, _, meta = unpack_message(payload)
+                # peer-supplied bytes end at this line: a frame that does
+                # not parse, or whose meta breaks _serve (missing
+                # from/port, wrong types), gets an error REPLY on the
+                # same connection — closing would punish a pipelining
+                # peer's later well-formed requests for one bad frame
+                try:
+                    msg_type, rid = peek_header(payload)
+                    _, _, meta = unpack_message(payload)
+                    if not isinstance(meta, dict):
+                        raise ValueError(
+                            f"meta must be a map, got {type(meta).__name__}"
+                        )
+                except Exception as e:
+                    # lah-lint: ignore[R1] tiny error frame
+                    await send_frame_parts(
+                        writer,
+                        pack_frames(
+                            "r", WireTensors.prepare(),
+                            {"error": f"malformed request: {e}"},
+                        ),
+                    )
+                    continue
                 if msg_type == "hello":
                     # v2 negotiation (utils/connection.py): the DHT
                     # speaks mux (rid-tagged replies over one socket)
                     # but not codec — control frames carry no tensors
+                    offered = meta.get("features")
                     feats = [
-                        f for f in (meta.get("features") or []) if f == "mux"
+                        f for f in (offered if isinstance(offered, list) else [])
+                        if f == "mux"
                     ]
                     # lah-lint: ignore[R1] tiny once-per-connection frame
                     hello_ok = pack_message("hello_ok", meta={"features": feats})
                     await send_frame(writer, hello_ok)
                     continue
-                reply = self._serve(msg_type, meta, peer_host)
+                try:
+                    reply = self._serve(msg_type, meta, peer_host)
+                except Exception as e:
+                    reply = {
+                        "error": f"bad {msg_type!r} request: "
+                                 f"{type(e).__name__}: {e}"
+                    }
                 # Serving is serial per connection (requests are small
                 # sync dict ops), but replies echo the request id so a
                 # mux client may pipeline freely.

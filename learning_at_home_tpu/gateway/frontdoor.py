@@ -253,8 +253,15 @@ class Gateway:
                 except Exception:
                     msg_type, rid = None, None
                 if msg_type == "hello":
-                    _, _, hmeta = unpack_message(payload)
-                    offered = hmeta.get("features") or []
+                    # peer-supplied hello: non-map meta / non-list offer
+                    # negotiates the empty set, never a torn connection
+                    try:
+                        _, _, hmeta = unpack_message(payload)
+                        offered = hmeta.get("features")
+                    except Exception:
+                        offered = None
+                    if not isinstance(offered, list):
+                        offered = []
                     common = [f for f in GATEWAY_FEATURES if f in offered]
                     muxed = "mux" in common
                     await self._send(

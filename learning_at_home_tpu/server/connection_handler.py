@@ -183,8 +183,16 @@ class ConnectionHandler:
                     # subset we speak; the connection is multiplexed from
                     # here on (request-id-tagged frames, replies in
                     # completion order)
-                    _, _, hmeta = unpack_message(payload)
-                    offered = hmeta.get("features") or []
+                    # hello meta is peer-supplied: a non-map meta or a
+                    # non-list offer negotiates the empty feature set
+                    # instead of tearing down the connection
+                    try:
+                        _, _, hmeta = unpack_message(payload)
+                        offered = hmeta.get("features")
+                    except Exception:
+                        offered = None
+                    if not isinstance(offered, list):
+                        offered = []
                     common = [f for f in SERVER_FEATURES if f in offered]
                     muxed = "mux" in common
                     await self._send(
@@ -519,6 +527,10 @@ class ConnectionHandler:
 
         try:
             msg_type, tensors, meta = unpack_message(payload)
+            if not isinstance(meta, dict):
+                raise ValueError(
+                    f"meta must be a map, got {type(meta).__name__}"
+                )
         except Exception as e:
             return reply("error", meta={"message": f"malformed request: {e}"})
         uid = meta.get("uid")

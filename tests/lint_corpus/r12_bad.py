@@ -1,0 +1,20 @@
+"""R12 corpus: the sender emits a meta field (``bogus``) that no
+handler of the op parses (must fire).  Both sides of the wire contract
+live in this file so the schema extractor sees handler and sender."""
+
+
+class _Handler:
+    def _dispatch(self, payload, rid=None):
+        msg_type, tensors, meta = unpack_message(payload)  # noqa: F821
+        if msg_type == "forward":
+            uid = meta.get("uid")
+            wire = meta.get("wire")
+            trace = meta.get("trace")
+            return uid, wire, trace
+        return None
+
+
+async def send(pool, tensors):
+    return await pool.rpc(
+        "forward", tensors, {"uid": "ffn.0", "bogus": 1}
+    )

@@ -1,0 +1,17 @@
+"""R12 corpus: every sender-emitted meta field is parsed by the op's
+handler (must be clean)."""
+
+
+class _Handler:
+    def _dispatch(self, payload, rid=None):
+        msg_type, tensors, meta = unpack_message(payload)  # noqa: F821
+        if msg_type == "forward":
+            uid = meta.get("uid")
+            wire = meta.get("wire")
+            trace = meta.get("trace")
+            return uid, wire, trace
+        return None
+
+
+async def send(pool, tensors):
+    return await pool.rpc("forward", tensors, {"uid": "ffn.0"})

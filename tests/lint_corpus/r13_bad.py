@@ -1,0 +1,22 @@
+"""R13 corpus: the handler hard-requires ``meta["uid"]`` but one sender
+construction path only sets it conditionally (must fire) — the exact
+shape of a retry/fallback branch dropping a field an old handler still
+subscripts."""
+
+
+class _Handler:
+    def _dispatch(self, payload, rid=None):
+        msg_type, tensors, meta = unpack_message(payload)  # noqa: F821
+        if msg_type == "forward":
+            uid = meta["uid"]
+            wire = meta.get("wire")
+            trace = meta.get("trace")
+            return uid, wire, trace
+        return None
+
+
+async def send(pool, tensors, tag=None):
+    meta = {"wire": "bfloat16", "trace": "t0"}
+    if tag is not None:
+        meta["uid"] = tag
+    return await pool.rpc("forward", tensors, meta)

@@ -1,0 +1,18 @@
+"""R13 corpus: the handler hard-requires ``meta["uid"]`` and every
+sender construction path guarantees it (must be clean)."""
+
+
+class _Handler:
+    def _dispatch(self, payload, rid=None):
+        msg_type, tensors, meta = unpack_message(payload)  # noqa: F821
+        if msg_type == "forward":
+            uid = meta["uid"]
+            wire = meta.get("wire")
+            trace = meta.get("trace")
+            return uid, wire, trace
+        return None
+
+
+async def send(pool, tensors, tag=None):
+    meta = {"uid": "ffn.0", "wire": "bfloat16", "trace": "t0"}
+    return await pool.rpc("forward", tensors, meta)

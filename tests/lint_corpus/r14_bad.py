@@ -1,0 +1,17 @@
+"""R14 corpus: feature-gated wire forms emitted without their
+negotiation guard (must fire twice) — the dict ``wire`` codec form
+without a dominating ``pool.supports("codec")`` test, and a rid-tagged
+frame built from a literal instead of the rid-echo/next_rid idioms."""
+
+
+async def send_encoded(pool, wire_obj, wmeta, tensors):
+    meta = {"uid": "ffn.0"}
+    if wmeta is not None:
+        meta["wire"] = wmeta
+    return await pool.rpc_prepared("forward", wire_obj, meta)
+
+
+def frame(msg_type, wire):
+    return pack_frames(  # noqa: F821
+        msg_type, wire, {"uid": "ffn.0"}, rid=7
+    )

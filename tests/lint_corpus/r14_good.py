@@ -1,0 +1,22 @@
+"""R14 corpus: feature-gated wire forms emitted correctly (must be
+clean) — the dict codec form behind ``pool.supports("codec")`` with the
+legacy-string fallback, a reply echoing its request's ``rid`` parameter,
+and a mux sender whose rid comes from ``mux.next_rid()``."""
+
+
+async def send_encoded(pool, wire_obj, wmeta, tensors):
+    meta = {"uid": "ffn.0"}
+    if pool.supports("codec"):
+        meta["wire"] = wmeta
+    else:
+        meta["wire"] = "bfloat16"
+    return await pool.rpc_prepared("forward", wire_obj, meta)
+
+
+def reply(msg_type, wire, rid=None):
+    return pack_frames(msg_type, wire, {"ok": "y"}, rid=rid)  # noqa: F821
+
+
+def mux_send(mux, msg_type, wire, meta):
+    rid = mux.next_rid()
+    return pack_frames(msg_type, wire, meta, rid=rid)  # noqa: F821

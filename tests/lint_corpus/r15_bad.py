@@ -1,0 +1,14 @@
+"""R15 corpus: the handler parses a meta field
+(``zzz_unfielded``) that PROTOCOL.md's machine-read field rows do not
+document for the op (must fire).  The doc corpus is the real repo
+docs/, resolved by walking up from this file."""
+
+
+class _Handler:
+    def _dispatch(self, payload, rid=None):
+        msg_type, tensors, meta = unpack_message(payload)  # noqa: F821
+        if msg_type == "forward":
+            uid = meta.get("uid")
+            zzz = meta.get("zzz_unfielded")
+            return uid, zzz
+        return None

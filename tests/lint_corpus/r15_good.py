@@ -1,0 +1,14 @@
+"""R15 corpus: the handler parses exactly the fields PROTOCOL.md's
+machine-read rows document for ``forward`` — ``uid`` plus the
+family-common ``wire``/``trace`` (must be clean)."""
+
+
+class _Handler:
+    def _dispatch(self, payload, rid=None):
+        msg_type, tensors, meta = unpack_message(payload)  # noqa: F821
+        if msg_type == "forward":
+            uid = meta.get("uid")
+            wire = meta.get("wire")
+            trace = meta.get("trace")
+            return uid, wire, trace
+        return None

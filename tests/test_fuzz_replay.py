@@ -1,0 +1,97 @@
+"""Schema-derived fuzzing (ISSUE 15): generation determinism, corpus
+round-trip, pinned-corpus freshness, and slow live replay.
+
+The contracts under test:
+
+- same seed → bit-identical case list (``--replay`` of a pinned corpus
+  and a fresh ``--smoke`` at its seed exercise the SAME frames);
+- different seeds actually differ (the mutations are seeded, not
+  constant);
+- the checked-in ``tests/fuzz_corpus/<family>.json`` corpora match what
+  the current schema regenerates — a wire-contract change that shifts
+  the field model forces a corpus re-emit in the same PR, so the pinned
+  regression set can never silently go stale;
+- (slow) every pinned corpus replays clean against a live instance of
+  its family: no crash, no hang, no wrongly-accepted reject probe, no
+  sanitizer violation.
+"""
+
+import importlib
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "learning_at_home_tpu")
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "fuzz_corpus")
+
+from learning_at_home_tpu.analysis.fuzz import (  # noqa: E402
+    FAMILIES,
+    STATEFUL_OPS,
+    dump_corpus,
+    generate_cases,
+    load_corpus,
+)
+
+# the emit-time compaction bound (tools/lah_fuzz.py --emit-corpus):
+# MiB-scale oversize-payload cases stay out of the pinned files
+MAX_PINNED_HEX = 2 * 64 * 1024
+
+
+def test_generation_is_deterministic_per_seed():
+    a = generate_cases(0, [PKG])
+    b = generate_cases(0, [PKG])
+    assert [c.to_json() for c in a] == [c.to_json() for c in b]
+    c = generate_cases(1, [PKG])
+    assert [x.to_json() for x in a] != [x.to_json() for x in c]
+
+
+def test_every_family_clears_the_floor():
+    cases = generate_cases(0, [PKG])
+    for fam in FAMILIES:
+        fam_cases = [c for c in cases if c.family == fam]
+        assert len(fam_cases) >= 200, fam
+        rejects = [c for c in fam_cases if c.expect == "reject"]
+        assert rejects, f"{fam} generated no expect-reject probes"
+        assert not any(c.op in STATEFUL_OPS for c in fam_cases), (
+            f"{fam} generated frames for a stateful op — a live barrage "
+            f"would drain/mutate the instance under test"
+        )
+
+
+def test_corpus_roundtrip(tmp_path):
+    cases = [c for c in generate_cases(3, [PKG]) if c.family == "dht"][:40]
+    path = str(tmp_path / "c.json")
+    dump_corpus(cases, path, meta={"seed": 3})
+    back = load_corpus(path)
+    assert [c.to_json() for c in back] == [c.to_json() for c in cases]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_pinned_corpus_matches_regeneration(family):
+    path = os.path.join(CORPUS_DIR, f"{family}.json")
+    with open(path) as fh:
+        raw = json.load(fh)
+    seed = raw["meta"]["seed"]
+    pinned = load_corpus(path)
+    fresh = [
+        c for c in generate_cases(seed, [PKG], families=(family,))
+        if len(c.frame_hex) <= MAX_PINNED_HEX
+    ]
+    assert [c.to_json() for c in pinned] == [c.to_json() for c in fresh], (
+        f"{family} corpus is stale — re-emit with "
+        f"`python tools/lah_fuzz.py --emit-corpus tests/fuzz_corpus "
+        f"--seed {seed}`"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", FAMILIES)
+def test_pinned_corpus_replays_clean(family):
+    lah_fuzz = importlib.import_module("tools.lah_fuzz")
+    cases = load_corpus(os.path.join(CORPUS_DIR, f"{family}.json"))
+    report = lah_fuzz.run_family(family, cases)
+    assert report["failures"] == [], report
+    assert report["frames"] == len(cases)
+    assert report["outcomes"]["noreply"] == 0

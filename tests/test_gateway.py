@@ -186,31 +186,33 @@ def test_gateway_rpc_end_to_end(swarm):
         m = st["metrics"]["collected"]
         assert m["lah_gateway_streams_total"] >= 1
         assert m["lah_gateway_tokens_total"] >= 5
-        # malformed submits are rejected with an error frame, not a hang
+        # malformed submits are rejected with an error frame, not a
+        # hang: the pinned battery (tests/fuzz_corpus, ISSUE 15) covers
+        # empty/out-of-vocab/no-decode-room/over-long prompts and bool
+        # token ids / token budgets.  Raw frames, since
+        # GatewayClient.submit int-coerces its arguments.
+        import json
+        import os
+
         from learning_at_home_tpu.utils.connection import RemoteCallError
 
-        with pytest.raises(RemoteCallError):
-            client.submit([], 5)
-        with pytest.raises(RemoteCallError):
-            client.submit([VOCAB + 7], 5)
-        with pytest.raises(RemoteCallError):
-            client.submit([1] * SEQ, 5)  # no cache room left to decode
-        # over-long prompts are a well-formed error frame, never a
-        # crash, a silent truncation, or a wedged pending queue
-        with pytest.raises(RemoteCallError):
-            client.submit([1] * (SEQ + 1), 5)
-        with pytest.raises(RemoteCallError):
-            client.submit([1] * (SEQ * 4), 5)
-        # bools are not token ids nor a token budget — raw frames here,
-        # since GatewayClient.submit int-coerces its arguments
-        with pytest.raises(RemoteCallError):
-            client._rpc(
-                "gen_submit", {"prompt": [True, False], "max_new_tokens": 5}
-            )
-        with pytest.raises(RemoteCallError):
-            client._rpc(
-                "gen_submit", {"prompt": [1, 2], "max_new_tokens": True}
-            )
+        path = os.path.join(os.path.dirname(__file__), "fuzz_corpus",
+                            "gateway_submit.json")
+        with open(path) as fh:
+            corpus = json.load(fh)
+        assert corpus["format"] == "lah-fuzz-battery-v1"
+        scope = {"VOCAB": VOCAB, "SEQ": SEQ}
+        for case in corpus["cases"]:
+            meta = {
+                k: eval(v[1:], dict(scope))
+                if isinstance(v, str) and v.startswith("$") else v
+                for k, v in case["meta"].items()
+            }
+            with pytest.raises(RemoteCallError):
+                client._rpc("gen_submit", meta)
+                raise AssertionError(
+                    f"malformed submit accepted: {case['name']}"
+                )
         # the gateway survived the whole battery: still serving
         out = client.generate([1, 2, 3], 5)
         assert not out.get("shed") and not out.get("error")

@@ -119,6 +119,112 @@ def test_cli_fails_on_bad_corpus():
     assert "R1" in r.stdout
 
 
+def test_r13_message_names_the_field_and_op():
+    findings = [
+        f for f in lint_paths([os.path.join(CORPUS, "r13_bad.py")])
+        if f.rule == "R13"
+    ]
+    assert findings, "R13 must fire on its bad corpus"
+    assert any("`uid`" in f.message and "`forward`" in f.message
+               for f in findings)
+
+
+def test_r14_fires_on_both_gate_shapes():
+    """The bad corpus seeds both skew shapes: the ungated dict wire
+    form AND the literal-rid frame."""
+    findings = [
+        f for f in lint_paths([os.path.join(CORPUS, "r14_bad.py")])
+        if f.rule == "R14"
+    ]
+    whats = {("wire" if "`wire`" in f.message else "rid")
+             for f in findings}
+    assert whats == {"wire", "rid"}
+
+
+def test_r15_fires_both_directions():
+    """Doc rows without a parse site AND parse sites without a doc row
+    are both drift."""
+    msgs = [
+        f.message
+        for f in lint_paths([os.path.join(CORPUS, "r15_bad.py")])
+        if f.rule == "R15"
+    ]
+    assert any("never parses" in m for m in msgs)  # stale doc row
+    assert any("no field row" in m for m in msgs)  # undocumented parse
+
+
+def test_wire_rules_require_written_reason(tmp_path):
+    """R12–R15 suppressions only baseline with a written reason: a bare
+    ``ignore[R12]`` marker leaves the finding active, the same marker
+    followed by an explanation suppresses it (ordinary rules keep the
+    old contract — R1 suppresses either way)."""
+    sender = (
+        "class _Handler:\n"
+        "    def _dispatch(self, payload, rid=None):\n"
+        "        msg_type, tensors, meta = unpack_message(payload)\n"
+        "        if msg_type == 'forward':\n"
+        "            return meta.get('uid')\n"
+        "        return None\n"
+        "\n"
+        "\n"
+        "async def send(pool, tensors):\n"
+        "    return await pool.rpc(\n"
+        "        'forward', tensors,\n"
+        "        {'uid': 'e',\n"
+        "         'bogus': 1}}  # MARKER\n"
+    )
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        sender.replace("}}  # MARKER", "},  # lah-lint: ignore[R12]\n    )")
+    )
+    findings = [
+        f for f in lint_paths([str(bare)]) if f.rule == "R12"
+    ]
+    assert findings and not findings[0].suppressed
+    assert "no written reason" in findings[0].message
+
+    reasoned = tmp_path / "reasoned.py"
+    reasoned.write_text(
+        sender.replace(
+            "}}  # MARKER",
+            "},  # lah-lint: ignore[R12] diagnostic tag, receiver "
+            "ignores it\n    )",
+        )
+    )
+    findings = [
+        f for f in lint_paths([str(reasoned)]) if f.rule == "R12"
+    ]
+    assert findings and findings[0].suppressed
+
+
+def test_wire_rules_block_comment_reason_counts(tmp_path):
+    """A bare marker inside a multi-line explanatory comment block is a
+    reasoned baseline — the block IS the reason."""
+    src = (
+        "class _Handler:\n"
+        "    def _dispatch(self, payload, rid=None):\n"
+        "        msg_type, tensors, meta = unpack_message(payload)\n"
+        "        if msg_type == 'forward':\n"
+        "            return meta.get('uid')\n"
+        "        return None\n"
+        "\n"
+        "\n"
+        "async def send(pool, tensors):\n"
+        "    return await pool.rpc(\n"
+        "        'forward', tensors,\n"
+        "        {'uid': 'e',\n"
+        "         # diagnostic tag: the receiver deliberately ignores\n"
+        "         # this field, it only feeds sender-side logs\n"
+        "         # lah-lint: ignore[R12]\n"
+        "         'bogus': 1},\n"
+        "    )\n"
+    )
+    path = tmp_path / "block.py"
+    path.write_text(src)
+    findings = [f for f in lint_paths([str(path)]) if f.rule == "R12"]
+    assert findings and findings[0].suppressed
+
+
 def test_parse_error_is_reported_not_crashed():
     import tempfile
 

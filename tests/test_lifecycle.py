@@ -309,45 +309,34 @@ def test_handoff_hostile_meta_rejected():
             pool.rpc("handoff", tensors, meta, timeout=10.0)
         )
 
+    # the pinned ORDERED battery (tests/fuzz_corpus, ISSUE 15): the ok
+    # entry opens session s3 that later entries kill and re-probe
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "fuzz_corpus",
+                        "handoff_meta.json")
+    with open(path) as fh:
+        corpus = json.load(fh)
+    assert corpus["format"] == "lah-fuzz-battery-v1"
+    arr = np.ones(3, np.float32)
+    manifest = [{"shape": [3], "dtype": "float32",
+                 "crc": lifecycle._leaf_crc(arr)}]
     try:
-        with pytest.raises(RemoteCallError, match="uid"):
-            rpc({"session": "s", "part": 0, "n_parts": 1, "manifest": []})
-        with pytest.raises(RemoteCallError, match="session"):
-            rpc({"uid": "h.0", "part": 0, "n_parts": 1, "manifest": []})
-        # part > 0 without an opened session
-        with pytest.raises(RemoteCallError, match="unknown handoff session"):
-            rpc({"uid": "h.0", "session": "s1", "part": 1, "n_parts": 2})
-        # part 0 must carry the manifest
-        with pytest.raises(RemoteCallError, match="manifest"):
-            rpc({"uid": "h.0", "session": "s2", "part": 0, "n_parts": 1})
-        # out-of-order part kills the session
-        arr = np.ones(3, np.float32)
-        manifest = [{"shape": [3], "dtype": "float32",
-                     "crc": lifecycle._leaf_crc(arr)}]
-        _, meta = rpc(
-            {"uid": "h.0", "session": "s3", "part": 0, "n_parts": 3,
-             "manifest": manifest}, (arr,),
-        )
-        assert meta["ok"] is True
-        with pytest.raises(RemoteCallError, match="out of order"):
-            rpc({"uid": "h.0", "session": "s3", "part": 2, "n_parts": 3})
-        # ... and the killed session is really gone
-        with pytest.raises(RemoteCallError, match="unknown handoff session"):
-            rpc({"uid": "h.0", "session": "s3", "part": 1, "n_parts": 3})
-        # more leaves than the manifest promises
-        with pytest.raises(RemoteCallError, match="more leaves"):
-            rpc(
-                {"uid": "h.0", "session": "s4", "part": 0, "n_parts": 1,
-                 "manifest": manifest}, (arr, arr),
-            )
-        # a manifest the receiver's template can't match is refused at
-        # finalize (no recipe here is also fine — any refusal works, the
-        # point is NO partial install)
-        with pytest.raises(RemoteCallError):
-            rpc(
-                {"uid": "h.0", "session": "s5", "part": 0, "n_parts": 1,
-                 "manifest": manifest}, (arr,),
-            )
+        for case in corpus["cases"]:
+            meta = {k: manifest if v == "$MANIFEST" else v
+                    for k, v in case["meta"].items()}
+            tensors = (arr,) * case["tensors"]
+            if case["expect"] == "ok":
+                _, reply = rpc(meta, tensors)
+                assert reply["ok"] is True, case["name"]
+            else:
+                with pytest.raises(RemoteCallError, match=case["match"]):
+                    rpc(meta, tensors)
+                    raise AssertionError(
+                        f"hostile handoff meta accepted: {case['name']}"
+                    )
+        # NO partial install survived any of it
         assert "h.0" not in srv.experts
         assert srv.handoff._sessions == {}
         assert srv.handoff.received == 0

@@ -262,24 +262,43 @@ def test_lazydecode_into_staging_buffer():
     np.testing.assert_array_equal(inputs[0], lazy_a.decode())
 
 
+def _load_header_battery():
+    """Pinned hostile-header corpus (tests/fuzz_corpus, ISSUE 15) —
+    the regression battery lives as data so ``lah_fuzz`` replays and
+    this test drive the SAME cases."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "fuzz_corpus",
+                        "wire_headers.json")
+    with open(path) as fh:
+        corpus = json.load(fh)
+    assert corpus["format"] == "lah-fuzz-battery-v1"
+
+    def resolve(v):
+        if isinstance(v, dict) and "$bytes_hex" in v:
+            return bytes.fromhex(v["$bytes_hex"])
+        if v == "$NAN":
+            return float("nan")
+        if v == "$BLOCKQ8_BLOCK":
+            return BLOCKQ8_BLOCK
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        return v
+
+    return [(c["name"], c["target"], c["data"], resolve(c["wire"]),
+             c["match"]) for c in corpus["cases"]]
+
+
 def test_lazydecode_validates_hostile_headers():
-    q = np.zeros((4, 100), np.int8)
-    with pytest.raises(ValueError, match="bs"):
-        LazyDecode(q, {"c": "blockq8", "m": b"", "s": b"", "bs": -1})
-    with pytest.raises(ValueError, match="means"):
-        LazyDecode(q, {"c": "blockq8", "m": b"\0" * 7, "s": b"\0" * 16,
-                       "bs": BLOCKQ8_BLOCK})
-    with pytest.raises(ValueError, match="uint8"):
-        LazyDecode(np.zeros(3, np.float32), {"c": "u8", "lo": 0.0, "sc": 1.0})
-    with pytest.raises(ValueError, match="finite"):
-        LazyDecode(np.zeros(3, np.uint8),
-                   {"c": "u8", "lo": float("nan"), "sc": 1.0})
-    with pytest.raises(ValueError, match="headers cover"):
-        decode_wire_tensors(
-            [np.zeros(3, np.uint8)], {"c": "u8", "h": []}
-        )
-    with pytest.raises(ValueError, match="codec"):
-        decode_wire_tensors([np.zeros(3, np.uint8)], {"c": "zstd", "h": [None]})
+    for name, target, data, wire, match in _load_header_battery():
+        payload = np.zeros(tuple(data["shape"]), np.dtype(data["dtype"]))
+        with pytest.raises(ValueError, match=match):
+            if target == "lazy":
+                LazyDecode(payload, wire)
+            else:
+                decode_wire_tensors([payload], wire)
+            raise AssertionError(f"hostile header accepted: {name}")
 
 
 # ---------------------------------------------------------------------------

@@ -10,7 +10,13 @@ gate before a single test collects.  Stage 0.5 is the VERIFY GATE
 drain lifecycle, and handoff receiver under permuted operation orders
 — any invariant violation fails the gate (rc=6), and so does the
 seeded-bug self-validation (the explorer must still re-find both PR-13
-races, deterministically).  Then ``pytest --collect-only`` on
+races, deterministically).  Stage 0.7 is the SCHEMA GATE (ISSUE 15):
+the AST wire-IR extractor must cover every op in the PROTOCOL.md
+tables, then ``lah_fuzz --smoke`` drives >=200 schema-derived hostile
+frames per dispatcher family (expert / gateway / averaging / dht)
+against live in-process instances — any crash, hang, wrongly-accepted
+reject probe, or sanitizer violation fails the gate (rc=7).  Then
+``pytest --collect-only`` on
 CPU exits non-zero on any collection error, then a CLIENT-PATH SMOKE:
 one forward+backward RPC against a local server under BOTH wire
 protocols (legacy/v1 and pipelined/v2), so wire-format breakage fails
@@ -39,8 +45,9 @@ edge count) at session end; set ``LAH_SANITIZE_SUMMARY=<path>`` to also
 export it as JSON, which this gate prints when present.
 
 ``--lint`` runs ONLY the lint stage; ``--verify`` runs ONLY the lint +
-verify stages; ``--no-smoke`` skips the RPC smoke; ``--smoke-worker``
-is the internal child mode that actually runs it.
+verify stages; ``--schema`` runs ONLY the lint + verify + schema
+stages; ``--no-smoke`` skips the RPC smoke; ``--smoke-worker`` is the
+internal child mode that actually runs it.
 """
 
 import os
@@ -144,6 +151,62 @@ def verify_stage() -> int:
         return 6
     tail = (r.stdout or "").strip().splitlines()
     print(f"collect_gate: verify OK — {tail[-1] if tail else ''}")
+    return 0
+
+
+def schema_stage() -> int:
+    """Stage 0.7: wire-schema conformance + hostile-input fuzz (ISSUE
+    15).  First an in-process check that the AST wire-IR extractor still
+    covers every op PROTOCOL.md documents (a new op wired up without a
+    handler entry in the IR would silently evade R12-R15 and the
+    fuzzer's field model), then ``lah_fuzz --smoke`` in a subprocess —
+    >=200 schema-derived mutated frames against live instances of all
+    four dispatcher families, tolerate-never-crash.  Fails (rc=7)."""
+    sys.path.insert(0, REPO)
+    try:
+        from learning_at_home_tpu.analysis.lint import (
+            _doc_corpus,
+            _find_docs_dir,
+        )
+        from learning_at_home_tpu.analysis.schema import coverage_report
+    except Exception as e:
+        print(f"collect_gate: schema stage unavailable ({e})",
+              file=sys.stderr)
+        return 7
+    pkg = os.path.join(REPO, "learning_at_home_tpu")
+    docs = _find_docs_dir(pkg)
+    doc_ops = _doc_corpus(docs)["ops"] if docs else {}
+    if not doc_ops:
+        print("collect_gate: FAIL — no PROTOCOL.md op tables found",
+              file=sys.stderr)
+        return 7
+    cov = coverage_report([pkg], doc_ops)
+    if not cov["ok"]:
+        print("collect_gate: FAIL — documented ops with no extracted "
+              f"handler schema: {cov['missing_handler']}", file=sys.stderr)
+        return 7
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("LAH_SANITIZE", "1")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lah_fuzz.py"),
+             "--smoke"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=int(os.environ.get("COLLECT_GATE_FUZZ_TIMEOUT_S",
+                                       "420")),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: lah_fuzz timed out", file=sys.stderr)
+        return 7
+    if r.returncode != 0:
+        print("collect_gate: FAIL — lah_fuzz:", file=sys.stderr)
+        print(r.stdout[-2000:], file=sys.stderr)
+        print(r.stderr[-1000:], file=sys.stderr)
+        return 7
+    tail = (r.stdout or "").strip().splitlines()
+    print(f"collect_gate: schema OK — {len(cov['ops'])} documented ops "
+          f"covered; {tail[-1] if tail else ''}")
     return 0
 
 
@@ -919,6 +982,11 @@ def main() -> int:
     if rc:
         return rc
     if "--verify" in sys.argv:
+        return 0
+    rc = schema_stage()  # stage 0.7: wire conformance + hostile fuzz
+    if rc:
+        return rc
+    if "--schema" in sys.argv:
         return 0
     rc = orphan_guard()  # BEFORE any timing work (smokes spawn servers)
     if rc:
