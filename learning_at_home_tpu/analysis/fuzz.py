@@ -70,13 +70,15 @@ SOFT_REJECT = {("gen_cancel", "sid")}
 
 # Ops that mutate durable server state: ``drain`` flips the lifecycle
 # with an EMPTY meta (every field is optional), ``replica`` installs an
-# expert from any uid string, ``handoff`` opens transfer sessions.  A
-# socket barrage over these would drain/mutate the very instance whose
-# liveness the run asserts, so they are excluded from generation and
-# reported as skipped; their hostile-meta validation is covered by the
-# in-process corpus replays (tests/fuzz_corpus/handoff_meta.json and
-# the lifecycle/drain test batteries).
-STATEFUL_OPS = ("drain", "replica", "handoff")
+# expert from any uid string, ``handoff`` opens transfer sessions, and
+# ``migrate`` hands a hosted expert off to an arbitrary target then
+# retires the source copy.  A socket barrage over these would
+# drain/mutate the very instance whose liveness the run asserts, so
+# they are excluded from generation and reported as skipped; their
+# hostile-meta validation is covered by the in-process corpus replays
+# (tests/fuzz_corpus/handoff_meta.json and the lifecycle/drain/migrate
+# test batteries).
+STATEFUL_OPS = ("drain", "replica", "handoff", "migrate")
 
 
 @dataclasses.dataclass

@@ -946,6 +946,211 @@ def explore_lifecycle(*, seed: int = 0,
 
 
 # --------------------------------------------------------------------------
+# migration world: single-expert placement move vs concurrent dispatches
+# --------------------------------------------------------------------------
+
+
+class _MigrationWorld:
+    """Drive the real ``lifecycle.run_migration`` against the fake drain
+    server on a virtual clock.  The handoff transfer exposes interleave
+    points (part boundaries) at which the schedule injects concurrent
+    dispatch work; the seeded-failure axis flips the handoff outcome.
+    Checks the two migrate invariants: retire strictly after the
+    successor's verified install acked (hoster count never dips), and a
+    failed handoff leaving the source hosted with its in-flight work
+    intact."""
+
+    def __init__(self, placement: dict, fail: bool):
+        from learning_at_home_tpu.server import lifecycle as lc
+
+        self._lc = lc
+        self.clock = _VirtualClock(step=0.0)
+        self._saved = (lc._monotonic, lc._sleep, lc.send_expert_handoff)
+        self.server = _FakeDrainServer(self.clock, n_experts=2)
+        self.server.migrations_out = 0
+        self.server.migration_failures = 0
+        self.placement = placement  # work-op name -> interleave index
+        self.fail = fail
+        self.point = 0
+        self.trace: list = []
+        self.target_installed = False
+        # (uid, target_installed_at_retire, in_flight_at_retire)
+        self.retire_snapshots: list = []
+
+        lc._monotonic = lambda: self.clock.now
+        lc._sleep = self._virt_sleep
+
+        def _fake_handoff(successor, uid, state, **kw):
+            # three part boundaries mid-transfer, then the verified ack
+            for _ in range(3):
+                self._at_point()
+            if self.fail:
+                raise lc.HandoffError(
+                    f"seeded migrate handoff failure for {uid}"
+                )
+            self.target_installed = True
+            self._at_point()
+            return {"installed": True, "verified": True}
+
+        lc.send_expert_handoff = _fake_handoff
+
+        real_retire = self.server._retire_expert
+
+        def _observed_retire(uid):
+            self.retire_snapshots.append(
+                (uid, self.target_installed, self.server.in_flight)
+            )
+            real_retire(uid)
+
+        self.server._retire_expert = _observed_retire
+
+    def _virt_sleep(self, seconds) -> None:
+        self.clock.now += max(0.0, float(seconds))
+        self._at_point()
+
+    def _at_point(self) -> None:
+        for op, when in sorted(self.placement.items()):
+            if when == self.point:
+                if op.startswith("batch_start"):
+                    self.server.in_flight += 1
+                elif op.startswith("batch_end"):
+                    self.server.in_flight = max(
+                        0, self.server.in_flight - 1
+                    )
+                self.trace.append(f"{op}@{self.point}")
+        self.point += 1
+
+    def run(self) -> list:
+        lc = self._lc
+        srv = self.server
+        leaks: list = []
+        in_flight_before = srv.in_flight
+        err = None
+        try:
+            lc.run_migration(srv, "e0", ("127.0.0.1", 2), timeout=5.0)
+        except lc.HandoffError as e:
+            err = e
+        except Exception as e:
+            leaks.append(
+                "migrate_failure_keeps_source: run_migration raised "
+                f"unexpected {type(e).__name__}: {e}"
+            )
+        # drain any trailing scheduled ops so a late batch_end lands
+        for _ in range(12):
+            self._at_point()
+        if self.fail:
+            if err is None:
+                leaks.append(
+                    "migrate_failure_keeps_source: seeded handoff "
+                    "failure did not surface as HandoffError"
+                )
+            if "e0" not in srv.experts:
+                leaks.append(
+                    "migrate_failure_keeps_source: source copy of e0 "
+                    "was lost after a failed handoff"
+                )
+            if self.retire_snapshots:
+                leaks.append(
+                    "migrate_failure_keeps_source: retire ran despite "
+                    "the failed handoff"
+                )
+            if srv.migration_failures != 1 or srv.migrations_out != 0:
+                leaks.append(
+                    "migrate_failure_keeps_source: counters after a "
+                    f"failed move: out={srv.migrations_out} "
+                    f"failures={srv.migration_failures} (expected 0/1)"
+                )
+        else:
+            if err is not None:
+                leaks.append(
+                    "migrate_handoff_before_retire: clean handoff "
+                    f"raised {type(err).__name__}: {err}"
+                )
+            for uid, installed, _n in self.retire_snapshots:
+                if not installed:
+                    leaks.append(
+                        "migrate_handoff_before_retire: expert "
+                        f"{uid} retired before the successor acked a "
+                        "verified install — the hoster count dipped "
+                        "below its pre-move value"
+                    )
+            if "e0" in srv.experts:
+                leaks.append(
+                    "migrate_handoff_before_retire: e0 still hosted "
+                    "after a successful migration (retire skipped)"
+                )
+            if srv.migrations_out != 1 or srv.migration_failures != 0:
+                leaks.append(
+                    "migrate_handoff_before_retire: counters after a "
+                    f"clean move: out={srv.migrations_out} "
+                    f"failures={srv.migration_failures} (expected 1/0)"
+                )
+        # either way: the bystander expert and in-flight accounting
+        # survive the move — a migration never touches work it does not
+        # own (dispatches complete on whichever copy holds them)
+        if "e1" not in srv.experts:
+            leaks.append(
+                "migrate_failure_keeps_source: unrelated expert e1 "
+                "disappeared during the migration"
+            )
+        # replay the schedule in its exact firing order (point asc,
+        # op-name asc within a point, the max(0, ..) clamp included) —
+        # the server's count must match: migrations neither drop nor
+        # duplicate live dispatch accounting
+        expect = in_flight_before
+        for op, _when in sorted(self.placement.items(),
+                                key=lambda kv: (kv[1], kv[0])):
+            if op.startswith("batch_start"):
+                expect += 1
+            elif op.startswith("batch_end"):
+                expect = max(0, expect - 1)
+        if srv.in_flight != expect:
+            leaks.append(
+                "migrate_failure_keeps_source: in-flight dispatch "
+                f"count drifted to {srv.in_flight} (expected {expect}) "
+                "— a migration dropped or duplicated live work"
+            )
+        return leaks
+
+    def close(self) -> None:
+        lc = self._lc
+        lc._monotonic, lc._sleep, lc.send_expert_handoff = self._saved
+
+
+def explore_migration(*, seed: int = 0,
+                      max_schedules: int = 120) -> ExplorationResult:
+    """Enumerate placements of concurrent dispatch work across the
+    migration's handoff part boundaries, crossed with the seeded
+    handoff-failure axis."""
+    result = ExplorationResult("migration", 0, 0, [])
+    n_points = 6
+    cases = []
+    for start in range(n_points):
+        for end in range(start, n_points + 3):
+            for fail in (False, True):
+                cases.append(
+                    ({"batch_start": start, "batch_end": end}, fail)
+                )
+    rot = seed % max(1, len(cases))
+    cases = cases[rot:] + cases[:rot]
+    for placement, fail in cases[:max_schedules]:
+        result.schedules_run += 1
+        world = _MigrationWorld(placement, fail)
+        try:
+            leaks = world.run()
+        finally:
+            world.close()
+        if leaks:
+            result.violations.extend(
+                Violation("migration", _leak_invariant(leak), leak,
+                          tuple(world.trace), result.schedules_run - 1)
+                for leak in leaks
+            )
+            break
+    return result
+
+
+# --------------------------------------------------------------------------
 # handoff receiver world: session cap / out-of-order / TTL on the clock
 # --------------------------------------------------------------------------
 
@@ -1037,6 +1242,7 @@ def run_all(*, seed: int = 0, max_schedules: int = 200) -> dict:
         explore_gateway(seed=seed, max_schedules=max_schedules // 2,
                         prefix_cache=True),
         explore_lifecycle(seed=seed, max_schedules=max_schedules),
+        explore_migration(seed=seed, max_schedules=max_schedules),
         check_handoff_receiver(seed=seed),
     ]
     violations = [v for r in results for v in r.violations]

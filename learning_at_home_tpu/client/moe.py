@@ -65,6 +65,11 @@ from learning_at_home_tpu.utils.profiling import new_trace_id, timeline
 
 logger = logging.getLogger(__name__)
 
+# co-activation table bound (ISSUE 16): distinct pairs tracked per MoE —
+# a k-of-grid gate selects O(k²) pairs per dispatch, so real workloads
+# sit far below this; the cap only bites on pathological gates
+COACT_MAX_PAIRS = 4096
+
 
 class MoEDispatchError(RuntimeError):
     """Total dispatch failure: no expert replied for ANY sample (or no
@@ -210,11 +215,21 @@ class RemoteMixtureOfExperts:
         )
         from learning_at_home_tpu.utils.serialization import CODEC_WIRE_RATIO
 
+        # placement/routing co-optimization (ISSUE 16): the swarm's
+        # published ``links.<prefix>`` RTT/bw EMAs feed the cost model
+        # as a prior for endpoints this process never dialed — the same
+        # link data the placement solver scores assignments on
+        link_getter = (
+            self._make_link_getter(source, telemetry_prefix)
+            if load_getter is not None
+            else None
+        )
         self.cost_model = RoutingCostModel(
             cost_weight,
             load_getter=load_getter,
             load_ttl=alive_ttl,
             codec_ratio=CODEC_WIRE_RATIO.get(self.wire_codec or "", 1.0),
+            link_getter=link_getter,
         )
         # hedged replica dispatch (ISSUE 8): once a forward fan-out call
         # to a replicated expert outlives ``hedge_mult × the primary
@@ -284,6 +299,17 @@ class RemoteMixtureOfExperts:
         self.dispatches = 0  # cumulative (deques above are windows)
         # per-dispatch selected-uid sets (bounded like dispatch_times)
         self.selection_log: deque[frozenset] = deque(maxlen=10_000)
+        # co-activation graph (ISSUE 16): bounded undirected pair counts
+        # accumulated at the gate — which experts this trainer fires
+        # TOGETHER.  Host-thread-owned plain dict (k_best is small, so a
+        # dispatch adds at most k·(k-1)/2 increments); scrape readers
+        # copy-with-retry like the deques.  The cap keeps a pathological
+        # gate from growing the table unboundedly: increments to new
+        # pairs past it are counted as dropped, existing pairs keep
+        # counting.
+        self.coact_counts: dict[str, int] = {}
+        self.coact_dispatches = 0
+        self.coact_pairs_dropped = 0
         # per-sample quorum telemetry: samples whose reply count fell below
         # k_min (forward) / backward_k_min (backward) and were masked out
         self.samples_total = 0
@@ -367,6 +393,44 @@ class RemoteMixtureOfExperts:
                 parsed = parse_load_value(value)
                 if isinstance(subkey, str) and parsed is not None:
                     out[subkey] = parsed
+            return out
+
+        return _get
+
+    @staticmethod
+    def _make_link_getter(source, prefix: str):
+        """TTL-refreshed ``host:port`` → ``{"rtt_s", "bw_bps"}`` map from
+        the swarm's ``links.<prefix>`` heartbeats: every publishing
+        peer's view of each destination, aggregated per destination by
+        MEDIAN rtt (robust to one peer's bad path) and median measured
+        bandwidth.  Same refresh discipline as the load getter."""
+
+        def _get() -> dict:
+            from learning_at_home_tpu.utils.telemetry import (
+                links_key,
+                parse_links_value,
+            )
+
+            records = client_loop().run(source.get(links_key(prefix)))
+            rtts: dict[str, list] = {}
+            bws: dict[str, list] = {}
+            for _subkey, entry in records.items():
+                value = entry[0] if isinstance(entry, (tuple, list)) else entry
+                parsed = parse_links_value(value)
+                if parsed is None:
+                    continue
+                for dst, ent in parsed.items():
+                    rtts.setdefault(dst, []).append(ent["rtt_s"])
+                    if ent["bw_bps"] is not None:
+                        bws.setdefault(dst, []).append(ent["bw_bps"])
+            out = {}
+            for dst, vals in rtts.items():
+                out[dst] = {
+                    "rtt_s": float(np.median(vals)),
+                    "bw_bps": (
+                        float(np.median(bws[dst])) if dst in bws else None
+                    ),
+                }
             return out
 
         return _get
@@ -679,9 +743,21 @@ class RemoteMixtureOfExperts:
             k_eff = sel.shape[1]
             # which experts this dispatch actually selected — the observable
             # the latency-aware-routing tests assert on (mechanism, not clock)
-            self.selection_log.append(
-                frozenset(alive_uids[e] for e in np.unique(sel))
-            )
+            chosen = sorted({alive_uids[e] for e in np.unique(sel)})
+            self.selection_log.append(frozenset(chosen))
+            # co-activation accumulation (ISSUE 16): every pair selected
+            # together this dispatch feeds the placement solver's graph
+            self.coact_dispatches += 1
+            for i in range(len(chosen)):
+                for j in range(i + 1, len(chosen)):
+                    key = f"{chosen[i]}|{chosen[j]}"
+                    n = self.coact_counts.get(key)
+                    if n is not None:
+                        self.coact_counts[key] = n + 1
+                    elif len(self.coact_counts) < COACT_MAX_PAIRS:
+                        self.coact_counts[key] = 1
+                    else:
+                        self.coact_pairs_dropped += 1
 
             # group rows by chosen expert: expert -> (rows, slots)
             jobs: dict[int, tuple[np.ndarray, np.ndarray]] = {}
@@ -1167,6 +1243,16 @@ class RemoteMixtureOfExperts:
             "lah_client_dispatch_p99_ms": p_ms(self.dispatch_times, 99),
             "lah_client_pack_p50_ms": p_ms(self.pack_times, 50),
             "lah_client_wait_p50_ms": p_ms(self.wait_times, 50),
+            # placement measurement (ISSUE 16): the co-activation graph
+            # this gate observed + routing's swarm-link-prior usage
+            "lah_placement_coact_pairs": len(self._snap_coact_counts()),
+            "lah_placement_coact_dispatches_total": self.coact_dispatches,
+            "lah_placement_coact_pairs_dropped_total": (
+                self.coact_pairs_dropped
+            ),
+            "lah_placement_link_fallbacks_total": (
+                self.cost_model.link_fallbacks
+            ),
         }
 
     def dispatch_stats(self) -> dict:
@@ -1231,12 +1317,54 @@ class RemoteMixtureOfExperts:
                 ),
                 "replica_counts": self._snap_replica_counts(),
             },
+            # placement measurement (ISSUE 16): what the rebalancer's
+            # snapshot builder scrapes off this trainer — the observed
+            # co-activation graph (top pairs), this process's measured
+            # per-destination link EMAs, and the mean payload size the
+            # solver turns into transfer-time terms
+            "placement": self.placement_stats(),
+        }
+
+    def placement_stats(self, top_pairs: int = 64) -> dict:
+        """Serializable placement-measurement section: bounded top-N of
+        the co-activation pair counts (count-desc then key, so the map
+        is deterministic for a given graph), the swarm-wire link
+        snapshot from this process's connection pools, and dispatch
+        bytes.  Shapes match what ``tools/lah_rebalance.py`` merges into
+        the solver snapshot."""
+        from learning_at_home_tpu.utils.telemetry import link_snapshot
+
+        coact = self._snap_coact_counts()
+        top = dict(
+            sorted(coact.items(), key=lambda kv: (-kv[1], kv[0]))
+            [:top_pairs]
+        )
+        dispatches = self.dispatches
+        return {
+            "coact": top,
+            "coact_pairs": len(coact),
+            "coact_dispatches": self.coact_dispatches,
+            "coact_pairs_dropped": self.coact_pairs_dropped,
+            "links": link_snapshot(),
+            "link_fallbacks": self.cost_model.link_fallbacks,
+            "bytes_per_dispatch": (
+                round(self.pack_bytes / dispatches, 1) if dispatches else 0.0
+            ),
         }
 
     def _snap_codec_counts(self) -> dict:
         for _ in range(4):
             try:
                 return dict(self.codec_counts)
+            except RuntimeError:
+                continue
+        return {}
+
+    def _snap_coact_counts(self) -> dict:
+        # copy-with-retry: scrapes race the host thread's pair inserts
+        for _ in range(4):
+            try:
+                return dict(self.coact_counts)
             except RuntimeError:
                 continue
         return {}

@@ -467,9 +467,19 @@ class RoutingCostModel:
     bitwise.  ``weight == 0`` returns ``bias=None``: selection is then
     bitwise identical to the blind gate (the A/B contract).
 
+    Placement/routing co-optimization (ISSUE 16): an optional
+    ``link_getter`` feeds the swarm's published ``links.<prefix>`` RTT/
+    bandwidth EMAs (utils/telemetry.py) in as a PRIOR for endpoints this
+    process has never dialed — the same link-cost data the placement
+    solver scores assignments on, so token routing and expert placement
+    move on one view instead of fighting.  A local pool measurement
+    always wins over the prior; with no getter the model is bitwise the
+    pre-ISSUE-16 one.
+
     All lookups are plain dict/attribute reads on the calling host
-    thread; the only I/O is the TTL-gated ``load_getter`` refresh (a
-    bounded control-plane DHT read, mirroring the alive-set cache).
+    thread; the only I/O is the TTL-gated ``load_getter``/``link_getter``
+    refresh (a bounded control-plane DHT read, mirroring the alive-set
+    cache).
     """
 
     def __init__(
@@ -481,6 +491,8 @@ class RoutingCostModel:
         load_ttl: float = 3.0,
         queue_cost_s: Optional[float] = None,
         codec_ratio: float = 1.0,
+        link_getter: Optional[Callable[[], dict]] = None,
+        link_ttl: float = 10.0,
     ):
         self.weight = float(weight)
         self._registry = registry
@@ -499,9 +511,17 @@ class RoutingCostModel:
         self.codec_ratio = codec_ratio
         self._loads: dict = {}
         self._loads_stamp = 0.0
+        self._link_getter = link_getter
+        self.link_ttl = link_ttl
+        self._links: dict = {}
+        self._links_stamp = 0.0
         # observability: how many bias computations actually had signal
         self.bias_applied = 0
         self.load_refresh_failures = 0
+        # co-optimization observability: predictions that fell back to a
+        # swarm-published link prior (no local pool measurement yet)
+        self.link_fallbacks = 0
+        self.link_refresh_failures = 0
 
     def _pools(self):
         if self._registry is not None:
@@ -537,23 +557,54 @@ class RoutingCostModel:
                 return None
         return None
 
+    def links(self) -> dict:
+        """endpoint-key ("host:port") → ``{"rtt_s", "bw_bps"}`` from the
+        swarm's published link records, TTL-refreshed like ``loads()``
+        (stamp-first; a failed refresh keeps the stale map one window)."""
+        if self._link_getter is None:
+            return self._links
+        now = time.monotonic()
+        if now - self._links_stamp > self.link_ttl:
+            self._links_stamp = now
+            try:
+                links = self._link_getter()
+                self._links = links if isinstance(links, dict) else {}
+            except Exception as e:
+                self.link_refresh_failures += 1
+                logger.debug("routing link refresh failed: %s: %s",
+                             type(e).__name__, e)
+        return self._links
+
     def predicted_cost_s(
         self, endpoint: Endpoint, nbytes: int = 0
     ) -> Optional[float]:
         """Predicted completion time for one dispatch to ``endpoint``;
-        None when there is NO signal (never contacted, no load record) —
-        the caller treats that as cost 0 (optimistic exploration)."""
+        None when there is NO signal (never contacted, no load record,
+        no published link) — the caller treats that as cost 0
+        (optimistic exploration)."""
         pool = self._pools().peek(endpoint)
         rtt = pool.rtt_ema if pool is not None else None
+        bw = pool.bw_ema if pool is not None else None
+        if rtt is None:
+            # swarm link prior (ISSUE 16): other peers' measurements of
+            # this endpoint, until the first local exchange lands
+            link = self.links().get(endpoint_key(endpoint))
+            if isinstance(link, dict):
+                try:
+                    rtt = float(link.get("rtt_s"))
+                except (TypeError, ValueError):
+                    rtt = None
+                if rtt is not None:
+                    self.link_fallbacks += 1
+                    if bw is None:
+                        lbw = link.get("bw_bps")
+                        bw = float(lbw) if isinstance(
+                            lbw, (int, float)
+                        ) and lbw > 0 else None
         q = self.queue_depth(endpoint)
         transfer = None
-        if (
-            nbytes > 0
-            and pool is not None
-            and pool.bw_ema is not None
-            and pool.bw_ema > 0
-        ):
-            transfer = (nbytes * self.codec_ratio) / pool.bw_ema
+        if nbytes > 0 and bw is not None and bw > 0:
+            transfer = (nbytes * self.codec_ratio) / bw
         if rtt is None and q is None and transfer is None:
             return None
         return (

@@ -464,6 +464,9 @@ class ConnectionHandler:
                 "bucket_cache_hits": hits,
             }
         from learning_at_home_tpu.utils.metrics import registry
+        from learning_at_home_tpu.utils.telemetry import (
+            link_snapshot as _link_snapshot,
+        )
 
         stats = {
             "n_experts": len(srv.experts),
@@ -486,6 +489,11 @@ class ConnectionHandler:
             # counters are never empty just because LAH_PROFILE is off —
             # this is the same snapshot the /metrics.json endpoint serves
             "metrics": registry.snapshot(),
+            # placement measurement + actuation (ISSUE 16): this
+            # server's measured per-destination link EMAs and its
+            # outbound-migration state — the rebalancer's stats-RPC view
+            "links": _link_snapshot(),
+            "placement": srv.placement_info(),
         }
         if include_spans:
             stats["spans"] = timeline.summary()
@@ -619,6 +627,43 @@ class ConnectionHandler:
                         meta=await self.server.handoff.handle_part(
                             meta, tensors
                         ),
+                    )
+                elif msg_type == "migrate":
+                    # placement actuation (ISSUE 16): move ONE hosted
+                    # expert to an explicit target over the handoff
+                    # wire, on the lah-migrate thread — handoff first,
+                    # retire only after the bitwise-verified install
+                    # (run_drain's per-uid order), so the uid's hoster
+                    # count never dips mid-move.  Reply is immediate;
+                    # callers watch the stats RPC's placement section.
+                    if not isinstance(uid, str) or not uid:
+                        raise ValueError("migrate request needs a uid")
+                    target = meta["target"]
+                    if not (
+                        isinstance(target, (list, tuple))
+                        and len(target) == 2
+                        and isinstance(target[0], str)
+                        and isinstance(target[1], int)
+                    ):
+                        raise ValueError(
+                            "migrate target must be [host, port]"
+                        )
+                    kwargs = {}
+                    timeout_s = meta.get("timeout")
+                    if timeout_s is not None:
+                        kwargs["timeout"] = min(
+                            600.0, max(1.0, float(timeout_s))
+                        )
+                    started = self.server.start_migration(
+                        uid, (target[0], target[1]), **kwargs
+                    )
+                    return reply(
+                        "result",
+                        meta={
+                            "uid": uid,
+                            "started": bool(started),
+                            "state": self.server.lifecycle_state,
+                        },
                     )
                 elif msg_type == "drain":
                     # graceful-drain trigger (ISSUE 9): flip the server

@@ -81,6 +81,14 @@ VERIFIED_INVARIANTS = (
     ("lifecycle.handoff_sessions_bounded",
      "the receiver never holds more than MAX_SESSIONS half-open "
      "sessions, and abandoned sessions are TTL-garbage-collected"),
+    ("lifecycle.migrate_handoff_before_retire",
+     "a live placement migration retires the source copy only after the "
+     "successor acked a bitwise-verified install — the uid's hoster "
+     "count never dips below its pre-move value"),
+    ("lifecycle.migrate_failure_keeps_source",
+     "a migration whose handoff failed leaves the source copy hosted "
+     "and serving — a failed move degrades to no move, never to a lost "
+     "expert or a dropped in-flight dispatch"),
 )
 
 # Lifecycle states a server advertises (stats RPC + telemetry extras;
@@ -253,6 +261,47 @@ def send_expert_handoff(
             f"verified install (reply meta: {final_meta})"
         )
     return final_meta
+
+
+def run_migration(
+    server: "Server", uid: str, successor: Endpoint, *,
+    timeout: float = 60.0,
+) -> dict:
+    """Move ONE serving expert to ``successor`` — the placement
+    rebalancer's actuation primitive (ISSUE 16; the ``migrate`` RPC's
+    background thread runs this).
+
+    Ordering is run_drain's per-uid success path, without the drain:
+    hand off first, retire the source copy only after the successor's
+    bitwise-verified install acked.  The source keeps SERVING the uid
+    through the whole transfer, so its hoster count never dips below
+    the pre-move value and dispatches in flight complete on whichever
+    copy holds them (VERIFIED_INVARIANTS: migrate_handoff_before_retire,
+    migrate_failure_keeps_source — the lah-verify migration world
+    explores exactly these interleavings).  A failed handoff raises
+    :class:`HandoffError` with the source untouched: a failed move
+    degrades to no move.
+
+    The handed-off state is the source's live snapshot at send time;
+    updates landing during the transfer stay on the source copy until
+    retire — the same bounded-staleness window a drain's quiesce timeout
+    accepts, and replica averaging reconverges it.
+    """
+    backend = server.experts.get(uid)
+    if backend is None:
+        raise ValueError(f"migrate: uid {uid!r} is not hosted here")
+    try:
+        send_expert_handoff(
+            tuple(successor), uid, backend.state_dict(), timeout=timeout
+        )
+    except Exception:
+        server.migration_failures += 1
+        raise
+    server._retire_expert(uid)
+    server.migrations_out += 1
+    logger.info("migrated %s -> %s:%s", uid, successor[0], successor[1])
+    return {"uid": uid, "target": list(successor), "handed_off": True,
+            "retired": True}
 
 
 # --------------------------------------------------------------------------

@@ -148,6 +148,12 @@ class Server:
         self.restarts = 0  # set by the CLI from the checkpoint root
         self.draining_since: Optional[float] = None
         self.migrated_in: set[str] = set()  # uids received via handoff
+        # placement actuation (ISSUE 16): outbound single-expert moves
+        # executed by the ``migrate`` RPC's lah-migrate thread; at most
+        # one in flight per server (the uid mid-move, else None)
+        self.migrations_out = 0
+        self.migration_failures = 0
+        self._migration_uid: Optional[str] = None
         self.handoff = HandoffReceiver(self)
         self._lifecycle_lock = sanitizer.lock("server.lifecycle")
         self._drain_thread: Optional[threading.Thread] = None
@@ -223,6 +229,13 @@ class Server:
             "lah_server_uptime_seconds": time.monotonic() - self.started_at,
             "lah_server_restarts_total": self.restarts,
             "lah_server_handoffs_received_total": self.handoff.received,
+            # placement actuation (ISSUE 16): outbound expert moves this
+            # server executed for the rebalancer, and moves whose
+            # handoff failed (source copy kept — a failed move is no move)
+            "lah_placement_migrations_out_total": self.migrations_out,
+            "lah_placement_migration_failures_total": (
+                self.migration_failures
+            ),
         }
 
     def _snap_queue_ema(self) -> dict:
@@ -412,6 +425,19 @@ class Server:
             "endpoint": list(self.endpoint),
             # lifecycle view (ISSUE 9): lah_top's STATE/UPTIME/RST columns
             "lifecycle": self.lifecycle_info(),
+            # placement view (ISSUE 16): lah_top's migration column and
+            # the rebalancer's snapshot of this server's outbound moves
+            "placement": self.placement_info(),
+        }
+
+    def placement_info(self) -> dict:
+        """Serializable placement-actuation snapshot (stats RPC +
+        telemetry extra): outbound move counters and the uid mid-move
+        (None when idle)."""
+        return {
+            "migrations_out": self.migrations_out,
+            "migration_failures": self.migration_failures,
+            "migration_in_flight": self._migration_uid,
         }
 
     def lifecycle_info(self) -> dict:
@@ -560,6 +586,8 @@ class Server:
         extra lookup) and one ``replicas.wanted.<prefix>`` entry per
         currently-hot expert."""
         from learning_at_home_tpu.utils.telemetry import (
+            link_snapshot,
+            links_key,
             load_key,
             replicas_wanted_key,
             telemetry_key,
@@ -595,6 +623,15 @@ class Server:
                         },
                         ttl, ep_key,
                     ))
+                    # measured link EMAs (ISSUE 16): this server's view
+                    # of the peers it dialed (handoffs, replica syncs) —
+                    # one more record in the same coalesced bundle
+                    links = link_snapshot()
+                    if links:
+                        extra.append((
+                            links_key(self.telemetry_prefix),
+                            {"l": links}, ttl, ep_key,
+                        ))
                     for uid, ema in hot.items():
                         extra.append((
                             replicas_wanted_key(self.telemetry_prefix),
@@ -747,6 +784,48 @@ class Server:
 
     def wait_drained(self, timeout: Optional[float] = None) -> bool:
         return self._drained.wait(timeout)
+
+    def start_migration(
+        self, uid: str, target: Endpoint, timeout: float = 60.0
+    ) -> bool:
+        """Fire-and-watch single-expert move on a ``lah-migrate`` daemon
+        thread (the ``migrate`` RPC's path — the serving loop replies
+        immediately and keeps serving the uid through the transfer).
+        One migration in flight per server; False when one already is,
+        when a drain owns the lifecycle, or when not SERVING.  Callers
+        watch the stats RPC's ``placement`` section
+        (``migrations_out`` / ``migration_failures`` /
+        ``migration_in_flight``) for the outcome.
+
+        Raises ValueError for a uid not hosted here (the RPC turns that
+        into an error reply) — refusals that depend on the lifecycle
+        return False instead, mirroring ``start_drain``."""
+        with self._lifecycle_lock:
+            if (
+                self.lifecycle_state != lifecycle.SERVING
+                or self._drain_thread is not None
+                or self._migration_uid is not None
+            ):
+                return False
+            if uid not in self.experts:
+                raise ValueError(f"migrate: uid {uid!r} is not hosted here")
+            self._migration_uid = uid
+
+            def _run():
+                try:
+                    lifecycle.run_migration(
+                        self, uid, target, timeout=timeout
+                    )
+                except Exception:
+                    logger.exception("background migration failed")
+                finally:
+                    self._migration_uid = None
+
+            thread = threading.Thread(
+                target=_run, name="lah-migrate", daemon=True
+            )
+        thread.start()
+        return True
 
     async def _declare_now(self, uid: str) -> None:
         """Immediate single-uid declare (serving loop): new/updated

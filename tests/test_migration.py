@@ -1,0 +1,248 @@
+"""Live expert migration — the placement driver's actuation primitive
+(ISSUE 16): the ``migrate`` RPC moves ONE serving expert between two
+live servers (handoff → bitwise-verified install → retire), the source
+keeps serving through the transfer, and a swarm under continuous
+dispatch load never drops a sample and never sees the uid's hoster
+count dip below the configured replication floor.
+
+The interleaving-exhaustive version of these invariants lives in the
+lah-verify migration world (analysis/verify.py:explore_migration);
+these tests drive the REAL stack over localhost sockets.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_at_home_tpu.client import reset_client_rpc
+from learning_at_home_tpu.client.moe import RemoteMixtureOfExperts
+from learning_at_home_tpu.client.rpc import client_loop, pool_registry
+from learning_at_home_tpu.dht import DHT
+from learning_at_home_tpu.server import lifecycle
+from learning_at_home_tpu.server.server import Server
+from learning_at_home_tpu.utils.connection import RemoteCallError
+from tests.test_lifecycle import assert_state_bitwise
+
+HID = 16
+
+
+@pytest.fixture(autouse=True)
+def _reset_rpc():
+    yield
+    reset_client_rpc()
+
+
+def _migrate_rpc(src_endpoint, uid, target, timeout=30.0, **extra):
+    meta = {"uid": uid, "target": [target[0], target[1]], **extra}
+    pool = pool_registry().get(src_endpoint)
+    _tensors, reply = client_loop().run(
+        pool.rpc("migrate", (), meta, timeout=timeout)
+    )
+    return reply
+
+
+def _stats_placement(endpoint):
+    pool = pool_registry().get(endpoint)
+    _tensors, meta = client_loop().run(
+        pool.rpc("stats", (), {}, timeout=10.0)
+    )
+    return meta.get("placement", {})
+
+
+def _wait_idle(endpoint, timeout_s=20.0):
+    """Poll the stats RPC (the driver's own idiom) until the source's
+    one migration slot frees; returns the final placement section."""
+    deadline = time.monotonic() + timeout_s
+    placement = {}
+    while time.monotonic() < deadline:
+        placement = _stats_placement(endpoint)
+        if placement.get("migration_in_flight") is None:
+            return placement
+        time.sleep(0.1)
+    return placement
+
+
+def test_migrate_rpc_moves_expert_bitwise():
+    """The full RPC path: a trained expert moves a → b with params AND
+    optimizer state bitwise, the source retires its copy only after the
+    verified install, the bystander expert stays, and both sides'
+    placement/lifecycle counters record the move."""
+    srv_a = Server.create(
+        expert_uids=["pl.0", "pl.1"], hidden_dim=HID, host="127.0.0.1",
+        optimizer=optax.adam(1e-3), dht=None,
+    )
+    srv_b = Server.create(
+        num_experts=0, hidden_dim=HID, host="127.0.0.1",
+        optimizer=optax.adam(1e-3), dht=None,
+    )
+    try:
+        # async updates make opt_state non-trivial (adam moments + count)
+        x = np.random.RandomState(0).randn(4, HID).astype(np.float32)
+        g = np.ones((4, HID), np.float32)
+        srv_a.experts["pl.0"].backward([x], [g])
+        srv_a.experts["pl.0"].backward([x], [g])
+        want = srv_a.experts["pl.0"].state_dict()
+
+        reply = _migrate_rpc(srv_a.endpoint, "pl.0", srv_b.endpoint)
+        assert reply["started"] is True
+        assert reply["uid"] == "pl.0"
+        assert reply["state"] == lifecycle.SERVING
+
+        placement = _wait_idle(srv_a.endpoint)
+        assert placement["migrations_out"] == 1
+        assert placement["migration_failures"] == 0
+        assert placement["migration_in_flight"] is None
+
+        # bitwise on the target, update_count carried, source retired
+        got = srv_b.experts["pl.0"].state_dict()
+        assert_state_bitwise(want, got)
+        assert got["update_count"] == want["update_count"]
+        assert "pl.0" in srv_b.migrated_in
+        assert "pl.0" not in srv_a.experts
+        # a migration is not a drain: the source keeps SERVING the rest
+        assert srv_a.lifecycle_state == lifecycle.SERVING
+        assert "pl.1" in srv_a.experts
+        # the moved expert still serves from b
+        fwd = np.asarray(srv_b.experts["pl.0"].forward([x])[0])
+        assert np.isfinite(fwd).all()
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+def test_migrate_rpc_validation_and_refusals():
+    """Malformed requests are error replies (never a started move); a
+    refusal that depends on the lifecycle is started=False instead."""
+    srv = Server.create(
+        expert_uids=["rv.0"], hidden_dim=HID, host="127.0.0.1",
+        optimizer=optax.sgd(0.01), dht=None,
+    )
+    try:
+        pool = pool_registry().get(srv.endpoint)
+        # missing uid / malformed target / unknown uid → error replies
+        for meta in (
+            {"target": ["127.0.0.1", 1]},
+            {"uid": "", "target": ["127.0.0.1", 1]},
+            {"uid": "rv.0", "target": "not-an-endpoint"},
+            {"uid": "rv.0", "target": ["host-only"]},
+            {"uid": "ghost.0", "target": ["127.0.0.1", 1]},
+        ):
+            with pytest.raises(RemoteCallError):
+                client_loop().run(
+                    pool.rpc("migrate", (), meta, timeout=10.0)
+                )
+        assert srv.placement_info()["migrations_out"] == 0
+        assert "rv.0" in srv.experts
+
+        # a drained server refuses with started=False (not an error)
+        srv.drain(grace=0.0, quiesce_timeout=2.0, handoff=False)
+        reply = _migrate_rpc(srv.endpoint, "rv.0", ("127.0.0.1", 1))
+        assert reply["started"] is False
+        assert reply["state"] == lifecycle.DRAINED
+    finally:
+        srv.shutdown()
+
+
+def test_migrate_to_dead_target_keeps_source_serving():
+    """A failed handoff degrades to NO move: the failure is counted,
+    the source still hosts and serves the uid."""
+    srv = Server.create(
+        expert_uids=["df.0"], hidden_dim=HID, host="127.0.0.1",
+        optimizer=optax.sgd(0.01), dht=None,
+    )
+    try:
+        # an unroutable target port: the handoff dies on connect/timeout
+        reply = _migrate_rpc(
+            srv.endpoint, "df.0", ("127.0.0.1", 1), timeout=6.0
+        )
+        assert reply["started"] is True  # the refusal happens in-flight
+        placement = _wait_idle(srv.endpoint, timeout_s=30.0)
+        assert placement["migration_failures"] == 1
+        assert placement["migrations_out"] == 0
+        assert "df.0" in srv.experts
+        assert srv.lifecycle_state == lifecycle.SERVING
+        x = np.random.RandomState(1).randn(2, HID).astype(np.float32)
+        assert np.isfinite(np.asarray(srv.experts["df.0"].forward([x])[0])).all()
+    finally:
+        srv.shutdown()
+
+
+def test_migration_under_dispatch_load_never_drops():
+    """The churn-harness acceptance: a live migration while a trainer
+    dispatches continuously — every uid stays hosted SOMEWHERE at every
+    observation (effective replication never below 1), zero dropped
+    samples end to end, and the moved expert lands bitwise."""
+    boot = DHT()
+    d_a = DHT(initial_peers=[boot.endpoint])
+    d_b = DHT(initial_peers=[boot.endpoint])
+    d_c = DHT(initial_peers=[boot.endpoint])
+    srv_a = Server.create(
+        expert_uids=["um.0", "um.1"], hidden_dim=HID, host="127.0.0.1",
+        optimizer=optax.sgd(0.01), dht=d_a, update_period=0.4,
+    )
+    srv_b = Server.create(
+        num_experts=0, hidden_dim=HID, host="127.0.0.1",
+        optimizer=optax.sgd(0.01), dht=d_b, update_period=0.4,
+    )
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            alive = d_c._loop.run(d_c._get_alive("um"))
+            if "um.0" in alive and "um.1" in alive:
+                break
+            time.sleep(0.1)
+        assert "um.0" in alive and "um.1" in alive, "never declared"
+
+        # DHT-sourced MoE: homes must MOVE mid-test, so the client has
+        # to re-resolve — k_min=1 lets the quorum absorb the stale
+        # window right after retire (the um.1 leg still answers)
+        moe = RemoteMixtureOfExperts(
+            in_features=HID, grid_size=(2,), uid_prefix="um", source=d_c,
+            k_best=2, k_min=1, forward_timeout=5.0,
+            timeout_after_k_min=2.0, alive_ttl=0.5,
+        )
+        gate = moe.init_gate_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(4, HID).astype(np.float32)
+        )
+        want = srv_a.experts["um.0"].state_dict()
+
+        def hosted_everywhere():
+            for uid in ("um.0", "um.1"):
+                assert uid in srv_a.experts or uid in srv_b.experts, (
+                    f"{uid} lost: replication dipped below 1"
+                )
+
+        migrated = False
+        for step in range(10):
+            if step == 3:
+                reply = _migrate_rpc(
+                    srv_a.endpoint, "um.0", srv_b.endpoint
+                )
+                assert reply["started"] is True
+                migrated = True
+            jax.block_until_ready(moe(x, gate))
+            hosted_everywhere()
+        assert migrated
+        placement = _wait_idle(srv_a.endpoint)
+        hosted_everywhere()
+        assert placement["migrations_out"] == 1
+        assert placement["migration_failures"] == 0
+        # the move really happened, bitwise
+        assert "um.0" not in srv_a.experts
+        assert_state_bitwise(want, srv_b.experts["um.0"].state_dict())
+        # the in-flight-dispatch half of the invariant: NOTHING dropped
+        assert moe.samples_dropped == 0
+        # and the post-move swarm still answers (routed to b's copy)
+        jax.block_until_ready(moe(x, gate))
+        assert moe.samples_dropped == 0
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+        reset_client_rpc()
+        for d in (d_a, d_b, d_c, boot):
+            d.shutdown()

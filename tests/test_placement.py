@@ -1,0 +1,309 @@
+"""Co-activation-aware placement (ISSUE 16): the pure cost model +
+seeded local-search solver, the `links.*` telemetry parsers, the
+rebalancer's pure snapshot builder and SLO gate, and the `--plan` CLI's
+byte-determinism contract (what the collect-gate placement stage runs).
+
+Live migration actuation (real servers, the `migrate` RPC) lives in
+test_migration.py; the routing-side link-prior fallback lives in
+test_routing_cost.py.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+from learning_at_home_tpu.analysis.placement import (
+    DEFAULT_RTT_S,
+    pair_key,
+    placement_cost,
+    plan_to_json,
+    solve,
+)
+from learning_at_home_tpu.utils.telemetry import (
+    MAX_ADVERTISED_LINKS,
+    links_key,
+    parse_links_value,
+)
+
+NODE_A = "10.0.0.1:31330"
+NODE_B = "10.0.0.2:31330"
+
+
+def clustered_snapshot():
+    """Two co-activation clusters split across two nodes with a slow,
+    measured inter-node link: the optimum consolidates each cluster."""
+    return {
+        "experts": {
+            "e.0": NODE_A, "e.1": NODE_B,
+            "e.2": NODE_A, "e.3": NODE_B,
+        },
+        "activations": {"e.0": 100, "e.1": 100, "e.2": 100, "e.3": 100},
+        "coact": {"e.0|e.1": 50, "e.2|e.3": 50},
+        "links": {NODE_A: {NODE_B: [0.03, 1.0e8]}},
+        "sources": {"trainer-a": 1.0},
+        "bytes_per_dispatch": 2.0e6,
+    }
+
+
+# ---- pair_key / cost model ----
+
+
+def test_pair_key_canonical_order():
+    assert pair_key("b", "a") == "a|b" == pair_key("a", "b")
+
+
+def test_placement_cost_counts_cross_node_pairs_once():
+    snap = clustered_snapshot()
+    cost = placement_cost(snap)
+    # both pairs straddle the measured link: 50·(0.03 + 2e6/1e8) each,
+    # plus the source term at DEFAULT_RTT_S per activation
+    link = 0.03 + 2.0e6 / 1.0e8
+    assert abs(cost - (100 * link + 400 * DEFAULT_RTT_S)) < 1e-9
+
+
+def test_colocated_pair_costs_zero():
+    snap = clustered_snapshot()
+    snap["experts"]["e.1"] = NODE_A
+    snap["experts"]["e.3"] = NODE_A
+    snap.pop("sources")
+    assert placement_cost(snap) == 0.0
+
+
+# ---- solver ----
+
+
+def test_solve_consolidates_clusters_and_improves_cost():
+    plan = solve(clustered_snapshot(), seed=0)
+    assert plan["moves"], plan
+    assert plan["cost_after"] < plan["cost_before"]
+    # every cluster ends co-located
+    final = {u: n for u, n in clustered_snapshot()["experts"].items()}
+    for m in plan["moves"]:
+        final[m["uid"]] = m["to"]
+    assert final["e.0"] == final["e.1"]
+    assert final["e.2"] == final["e.3"]
+
+
+def test_solve_deterministic_byte_identical_per_seed():
+    a = plan_to_json(solve(clustered_snapshot(), seed=7))
+    b = plan_to_json(solve(clustered_snapshot(), seed=7))
+    assert a == b
+    # a different seed may visit differently but still returns a plan
+    assert isinstance(solve(clustered_snapshot(), seed=8)["moves"], list)
+
+
+def test_solve_respects_capacity():
+    snap = clustered_snapshot()
+    snap["capacity"] = {NODE_A: 2, NODE_B: 2}
+    plan = solve(snap, seed=0)
+    occupancy = {NODE_A: 0, NODE_B: 0}
+    final = dict(snap["experts"])
+    for m in plan["moves"]:
+        final[m["uid"]] = m["to"]
+    for node in final.values():
+        occupancy[node] += 1
+    assert occupancy[NODE_A] <= 2 and occupancy[NODE_B] <= 2
+
+
+def test_solve_caps_distinct_moved_experts():
+    # a 12-expert chain all wanting to consolidate; max_moves must bound
+    # the DISTINCT experts moved, keeping plans executable move-for-move
+    uids = [f"m.{i}" for i in range(12)]
+    snap = {
+        "experts": {u: (NODE_A if i % 2 else NODE_B)
+                    for i, u in enumerate(uids)},
+        "coact": {pair_key(uids[i], uids[i + 1]): 100
+                  for i in range(len(uids) - 1)},
+        "links": {NODE_A: {NODE_B: [0.05, None]}},
+        "bytes_per_dispatch": 0.0,
+    }
+    plan = solve(snap, seed=0, max_moves=3)
+    assert 0 < len({m["uid"] for m in plan["moves"]}) <= 3
+
+
+def test_solve_tolerates_garbage_snapshots():
+    for snap in (
+        None, [], {}, {"experts": "nope"},
+        {"experts": {1: 2, "u": None}},
+        {"experts": {"u": NODE_A}},  # one node: nothing to solve
+        {"experts": {"u": NODE_A, "v": NODE_B},
+         "coact": {"u|v": float("nan"), 3: 1, "u|u": 5, "u|ghost": 2},
+         "links": {NODE_A: "junk", 7: {}},
+         "activations": {"u": -1, "v": True},
+         "sources": {"s": "hot"},
+         "bytes_per_dispatch": "many"},
+    ):
+        plan = solve(snap, seed=0)
+        assert plan["moves"] == []
+        assert plan["cost_after"] == plan["cost_before"]
+
+
+def test_gain_fields_sorted_and_positive():
+    plan = solve(clustered_snapshot(), seed=0)
+    gains = [m["gain"] for m in plan["moves"]]
+    assert gains == sorted(gains, reverse=True)
+    assert all(g > 0 for g in gains)
+
+
+# ---- links.* telemetry parsing ----
+
+
+def test_links_key_scoped_by_prefix():
+    assert links_key("swarm") == "links.swarm"
+    assert links_key("other") != links_key("swarm")
+
+
+def test_parse_links_value_roundtrip_and_garbage():
+    got = parse_links_value(
+        {"l": {"10.0.0.2:31330": [0.02, 1.5e8],
+               "10.0.0.3:31330": [0.05, None]}}
+    )
+    assert got == {
+        "10.0.0.2:31330": {"rtt_s": 0.02, "bw_bps": 1.5e8},
+        "10.0.0.3:31330": {"rtt_s": 0.05, "bw_bps": None},
+    }
+    # outer-shape garbage -> None; per-entry garbage -> skipped
+    for bad in (None, 17, [], "x", {"nope": {}}, {"l": "x"}):
+        assert parse_links_value(bad) is None
+    partial = parse_links_value(
+        {"l": {"10.0.0.2:31330": [0.02, 1e8],
+               "noport": [0.01, 1e8],          # dst must look host:port
+               "10.0.0.4:1": ["fast", 1e8],    # rtt must be numeric
+               "10.0.0.5:1": [float("nan")],   # NaN rtt is garbage
+               "10.0.0.6:1": [-0.1],           # negative rtt is garbage
+               "10.0.0.7:1": [0.03, -5]}}      # bad bw degrades to None
+    )
+    assert set(partial) == {"10.0.0.2:31330", "10.0.0.7:1"}
+    assert partial["10.0.0.7:1"] == {"rtt_s": 0.03, "bw_bps": None}
+    assert MAX_ADVERTISED_LINKS >= 1
+
+
+# ---- rebalancer: pure snapshot builder + SLO gate ----
+
+
+_REBALANCE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "lah_rebalance.py",
+)
+_REBALANCE_MOD = None
+
+
+def _rebalance():
+    global _REBALANCE_MOD
+    if _REBALANCE_MOD is None:
+        spec = importlib.util.spec_from_file_location(
+            "lah_rebalance_placement", _REBALANCE_PATH
+        )
+        _REBALANCE_MOD = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_REBALANCE_MOD)
+    return _REBALANCE_MOD
+
+
+def test_build_snapshot_merges_servers_trainers_and_dht_links():
+    reb = _rebalance()
+    rows = [
+        {"peer_id": "srv-a", "role": "server", "snapshot": {
+            "endpoint": ["10.0.0.1", 31330],
+            "experts": {"e.0": 5, "e.2": 3}}},
+        {"peer_id": "srv-b", "role": "server", "snapshot": {
+            "endpoint": ["10.0.0.2", 31330],
+            "experts": {"e.1": 4, "e.3": 2}}},
+        {"peer_id": "trn-a", "role": "trainer", "snapshot": {
+            "dispatch": {"placement": {
+                "coact": {"e.0|e.1": 50, "e.2|e.3": 40},
+                "coact_dispatches": 90,
+                "links": {NODE_A: {"rtt_s": 0.002, "bw_bps": 2e8}},
+                "bytes_per_dispatch": 1.5e6}}}},
+        {"peer_id": "dead", "role": "server", "snapshot": None},
+    ]
+    dht_links = {NODE_A: {NODE_B: {"rtt_s": 0.04, "bw_bps": 5e7}}}
+    snap = reb.build_snapshot(rows, dht_links)
+    assert snap["experts"] == {
+        "e.0": NODE_A, "e.2": NODE_A, "e.1": NODE_B, "e.3": NODE_B,
+    }
+    assert snap["activations"]["e.0"] == 5.0
+    assert snap["coact"] == {"e.0|e.1": 50.0, "e.2|e.3": 40.0}
+    assert snap["sources"] == {"trn-a": 90.0}
+    assert snap["links"]["trn-a"][NODE_A]["rtt_s"] == 0.002
+    assert snap["links"][NODE_A][NODE_B]["rtt_s"] == 0.04
+    assert snap["bytes_per_dispatch"] == 1.5e6
+    # the merged snapshot is solvable end to end
+    plan = solve(snap, seed=0)
+    assert plan["cost_after"] <= plan["cost_before"]
+
+
+def test_build_snapshot_tolerates_garbage_rows():
+    reb = _rebalance()
+    snap = reb.build_snapshot(
+        [None, {}, {"snapshot": 5}, {"peer_id": "x", "snapshot": {
+            "endpoint": ["h"], "experts": {"u": 1},
+            "dispatch": {"placement": {"coact": "nope"}}}}],
+        dht_links="junk",
+    )
+    assert snap["experts"] == {} and snap["coact"] == {}
+
+
+def test_slo_gate_fires_on_p99_and_shed_regression():
+    reb = _rebalance()
+
+    class Args:
+        slo_p99_factor = 1.5
+        slo_shed_margin = 0.05
+
+    base = {"p99_ms": 100.0, "shed_fraction": 0.01}
+    ok = {"p99_ms": 120.0, "shed_fraction": 0.02}
+    assert reb._slo_degraded(base, ok, Args()) == ""
+    assert "p99" in reb._slo_degraded(
+        base, {"p99_ms": 200.0, "shed_fraction": 0.01}, Args()
+    )
+    assert "shed" in reb._slo_degraded(
+        base, {"p99_ms": 100.0, "shed_fraction": 0.2}, Args()
+    )
+    # no baseline p99 yet (cold swarm): the p99 arm never fires
+    cold = {"p99_ms": 0.0, "shed_fraction": 0.0}
+    assert reb._slo_degraded(
+        cold, {"p99_ms": 500.0, "shed_fraction": 0.0}, Args()
+    ) == ""
+
+
+def test_sample_slo_takes_worst_trainer():
+    reb = _rebalance()
+    rows = [
+        {"snapshot": {"metrics": {"collected": {
+            "lah_client_dispatch_p99_ms": 80.0,
+            "lah_client_samples_total": 100,
+            "lah_client_samples_dropped_total": 10}}}},
+        {"snapshot": {"metrics": {"collected": {
+            "lah_client_dispatch_p99_ms": 120.0,
+            "lah_client_samples_total": 100,
+            "lah_client_samples_dropped_total": 0}}}},
+        {"snapshot": None},
+    ]
+    slo = reb.sample_slo(rows)
+    assert slo["p99_ms"] == 120.0
+    assert abs(slo["shed_fraction"] - 0.05) < 1e-12
+
+
+# ---- --plan CLI: the collect-gate determinism contract ----
+
+
+def test_plan_cli_byte_identical_across_processes(tmp_path):
+    snap_path = tmp_path / "snap.json"
+    snap_path.write_text(json.dumps(clustered_snapshot()))
+    outs = []
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, _REBALANCE_PATH,
+             "--plan", str(snap_path), "--seed", "0"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        outs.append(r.stdout)
+    assert outs[0] == outs[1]
+    plan = json.loads(outs[0])
+    assert plan["moves"] and plan["cost_after"] < plan["cost_before"]
+    assert outs[0].strip() == plan_to_json(
+        solve(clustered_snapshot(), seed=0)
+    )

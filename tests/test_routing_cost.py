@@ -240,6 +240,82 @@ def test_dht_replica_aware_declare_and_resolution():
         d1.shutdown()
 
 
+# ---- swarm link prior (ISSUE 16 placement/routing co-optimization) ----
+
+
+def test_link_prior_used_when_no_local_measurement():
+    """Never-dialed endpoint + published link record → the prediction
+    falls back to the swarm prior (rtt AND bandwidth) and counts it."""
+    model = RoutingCostModel(
+        1.0, registry=FakeRegistry({}), codec_ratio=1.0,
+        link_getter=lambda: {
+            endpoint_key(EP_A): {"rtt_s": 0.2, "bw_bps": 1e6},
+        },
+    )
+    cost = model.predicted_cost_s(EP_A, nbytes=500_000)
+    assert cost == pytest.approx(0.2 + 0.5, abs=1e-9)
+    assert model.link_fallbacks == 1
+    # no prior for EP_B either → still no signal
+    assert model.predicted_cost_s(EP_B) is None
+
+
+def test_local_pool_measurement_beats_link_prior():
+    reg = FakeRegistry({EP_A: FakePool(rtt_ema=0.05)})
+    model = RoutingCostModel(
+        1.0, registry=reg,
+        link_getter=lambda: {endpoint_key(EP_A): {"rtt_s": 0.9, "bw_bps": None}},
+    )
+    assert model.predicted_cost_s(EP_A) == pytest.approx(0.05)
+    assert model.link_fallbacks == 0
+
+
+def test_no_link_getter_is_bitwise_pre_change():
+    """link_getter=None (the default): the prior map stays empty and an
+    unmeasured endpoint still predicts None — exactly the pre-ISSUE-16
+    model."""
+    model = RoutingCostModel(1.0, registry=FakeRegistry({}))
+    assert model.links() == {}
+    assert model.predicted_cost_s(EP_A) is None
+    assert model.link_fallbacks == 0
+
+
+def test_link_refresh_ttl_and_failure_keeps_stale():
+    calls = []
+
+    def getter():
+        calls.append(1)
+        if len(calls) == 2:
+            raise OSError("dht flake")
+        return {endpoint_key(EP_A): {"rtt_s": 0.1 * len(calls), "bw_bps": None}}
+
+    model = RoutingCostModel(
+        1.0, registry=FakeRegistry({}), link_getter=getter, link_ttl=0.05
+    )
+    assert model.predicted_cost_s(EP_A) == pytest.approx(0.1)
+    assert model.predicted_cost_s(EP_A) == pytest.approx(0.1)
+    assert len(calls) == 1  # within TTL: no second fetch
+    time.sleep(0.06)
+    # refresh fails → stale prior survives one window, failure counted
+    assert model.predicted_cost_s(EP_A) == pytest.approx(0.1)
+    assert model.link_refresh_failures == 1
+    time.sleep(0.06)
+    assert model.predicted_cost_s(EP_A) == pytest.approx(0.3)
+    assert model.link_fallbacks == 4
+
+
+def test_link_prior_garbage_record_is_no_signal():
+    model = RoutingCostModel(
+        1.0, registry=FakeRegistry({}),
+        link_getter=lambda: {
+            endpoint_key(EP_A): {"rtt_s": "soon", "bw_bps": 1e6},
+            endpoint_key(EP_B): "junk",
+        },
+    )
+    assert model.predicted_cost_s(EP_A) is None
+    assert model.predicted_cost_s(EP_B) is None
+    assert model.link_fallbacks == 0
+
+
 # ---- rebalancer planning (tools/lah_rebalance.py, pure step) ----
 
 

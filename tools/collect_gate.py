@@ -15,7 +15,11 @@ the AST wire-IR extractor must cover every op in the PROTOCOL.md
 tables, then ``lah_fuzz --smoke`` drives >=200 schema-derived hostile
 frames per dispatcher family (expert / gateway / averaging / dht)
 against live in-process instances — any crash, hang, wrongly-accepted
-reject probe, or sanitizer violation fails the gate (rc=7).  Then
+reject probe, or sanitizer violation fails the gate (rc=7).  Stage 0.8
+is the PLACEMENT GATE (ISSUE 16): ``lah_rebalance --plan`` runs twice
+over an embedded skewed co-activation fixture and must print
+byte-identical, non-empty, cost-improving plans (rc=8) — the live
+SLO-gated migration driver replays these plans move-for-move.  Then
 ``pytest --collect-only`` on
 CPU exits non-zero on any collection error, then a CLIENT-PATH SMOKE:
 one forward+backward RPC against a local server under BOTH wire
@@ -50,6 +54,7 @@ stages; ``--no-smoke`` skips the RPC smoke; ``--smoke-worker`` is the
 internal child mode that actually runs it.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -207,6 +212,107 @@ def schema_stage() -> int:
     tail = (r.stdout or "").strip().splitlines()
     print(f"collect_gate: schema OK — {len(cov['ops'])} documented ops "
           f"covered; {tail[-1] if tail else ''}")
+    return 0
+
+
+# a skewed two-node fixture with two co-activation clusters split across
+# the nodes and a slow measured link: the solver MUST consolidate (the
+# plan is non-trivial) and MUST be byte-deterministic per seed — the
+# live rebalancer replays plans move-for-move, so two driver instances
+# with the same snapshot must never disagree
+_PLACEMENT_FIXTURE = {
+    "experts": {
+        "expert.0": "10.0.0.1:31330", "expert.1": "10.0.0.2:31330",
+        "expert.2": "10.0.0.1:31330", "expert.3": "10.0.0.2:31330",
+        "expert.4": "10.0.0.1:31330", "expert.5": "10.0.0.2:31330",
+    },
+    "activations": {
+        "expert.0": 900, "expert.1": 850, "expert.2": 800,
+        "expert.3": 120, "expert.4": 100, "expert.5": 80,
+    },
+    "coact": {
+        "expert.0|expert.1": 700, "expert.1|expert.2": 650,
+        "expert.0|expert.2": 600, "expert.3|expert.4": 90,
+        "expert.4|expert.5": 80,
+    },
+    "links": {
+        "10.0.0.1:31330": {"10.0.0.2:31330": [0.04, 5.0e7]},
+        "trainer-a": {
+            "10.0.0.1:31330": [0.002, 2.0e8],
+            "10.0.0.2:31330": [0.05, 4.0e7],
+        },
+    },
+    "sources": {"trainer-a": 1.0},
+    "bytes_per_dispatch": 1.5e6,
+}
+
+
+def placement_stage() -> int:
+    """Stage 0.8: placement-solver determinism smoke (ISSUE 16).  Runs
+    ``lah_rebalance --plan`` twice over an embedded skewed fixture in
+    subprocesses and fails (rc=8) unless both plans are byte-identical,
+    non-empty, and strictly cost-improving — the properties the live
+    SLO-gated driver depends on."""
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as fh:
+        json.dump(_PLACEMENT_FIXTURE, fh)
+        snap_path = fh.name
+    try:
+        outs = []
+        for _ in range(2):
+            try:
+                r = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "lah_rebalance.py"),
+                     "--plan", snap_path, "--seed", "0"],
+                    cwd=REPO, env=env, capture_output=True, text=True,
+                    timeout=int(os.environ.get(
+                        "COLLECT_GATE_PLACEMENT_TIMEOUT_S", "60")),
+                )
+            except subprocess.TimeoutExpired:
+                print("collect_gate: lah_rebalance --plan timed out",
+                      file=sys.stderr)
+                return 8
+            if r.returncode != 0:
+                print("collect_gate: FAIL — lah_rebalance --plan:",
+                      file=sys.stderr)
+                print(r.stdout[-2000:], file=sys.stderr)
+                print(r.stderr[-1000:], file=sys.stderr)
+                return 8
+            outs.append(r.stdout)
+    finally:
+        os.unlink(snap_path)
+    if outs[0] != outs[1]:
+        print("collect_gate: FAIL — placement plans for one (snapshot, "
+              "seed) differ between runs:", file=sys.stderr)
+        print(outs[0], file=sys.stderr)
+        print(outs[1], file=sys.stderr)
+        return 8
+    try:
+        plan = json.loads(outs[0])
+    except ValueError:
+        print("collect_gate: FAIL — --plan printed non-JSON:",
+              file=sys.stderr)
+        print(outs[0][-500:], file=sys.stderr)
+        return 8
+    if not plan.get("moves"):
+        print("collect_gate: FAIL — solver found no moves on the skewed "
+              "fixture (must consolidate the split clusters)",
+              file=sys.stderr)
+        return 8
+    if not plan["cost_after"] < plan["cost_before"]:
+        print("collect_gate: FAIL — plan does not improve cost "
+              f"({plan['cost_before']} -> {plan['cost_after']})",
+              file=sys.stderr)
+        return 8
+    print(f"collect_gate: placement OK — byte-identical plan, "
+          f"{len(plan['moves'])} move(s), cost {plan['cost_before']} -> "
+          f"{plan['cost_after']}")
     return 0
 
 
@@ -987,6 +1093,11 @@ def main() -> int:
     if rc:
         return rc
     if "--schema" in sys.argv:
+        return 0
+    rc = placement_stage()  # stage 0.8: placement-plan determinism
+    if rc:
+        return rc
+    if "--placement" in sys.argv:
         return 0
     rc = orphan_guard()  # BEFORE any timing work (smokes spawn servers)
     if rc:

@@ -14,6 +14,10 @@ and renders one aggregated view:
   columns PAGES (``used/total`` physical pages) and PFX-HIT
   (prefix-cache hits) from their ``gateway`` snapshot section;
 - an expert table merged across servers: per-expert async update counts;
+- a placement panel (ISSUE 16): the hottest gate co-activation pairs
+  with each expert's home node, plus the migration ledger — per-server
+  completed/failed counts, moves in flight, and the rebalancing
+  driver's aborted-by-SLO total when one is heartbeating;
 - dead peers: ids seen in an earlier refresh whose record expired, plus
   peers whose record is live but whose endpoint stopped answering.
 
@@ -247,6 +251,63 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
             lines.append(
                 f"  {uid:<32} {int(experts[uid]):>10} "
                 f"{expert_hosts.get(uid, 0):>9}{flags}"
+            )
+    # placement panel (ISSUE 16): the co-activation pairs trainers
+    # measured at the gate (merged, hottest first) with each side's
+    # home node(s), plus the migration ledger — per-server outbound
+    # counters and, when a rebalancer heartbeats, the driver's
+    # completed / failed / aborted-by-SLO totals
+    coact: dict[str, float] = {}
+    homes: dict[str, set] = {}
+    mig_out = mig_fail = 0
+    mig_inflight: list[str] = []
+    driver = None
+    for row in rows:
+        for uid in _section(row, "experts"):
+            homes.setdefault(uid, set()).add(row["peer_id"])
+        pl = _section(row, "dispatch").get("placement")
+        if isinstance(pl, dict) and isinstance(pl.get("coact"), dict):
+            for key, n in pl["coact"].items():
+                if isinstance(key, str):
+                    coact[key] = coact.get(key, 0.0) + _num(n)
+        srv_pl = _section(row, "placement")
+        if srv_pl:
+            mig_out += int(_num(srv_pl.get("migrations_out")))
+            mig_fail += int(_num(srv_pl.get("migration_failures")))
+            moving = srv_pl.get("migration_in_flight")
+            if isinstance(moving, str) and moving:
+                mig_inflight.append(f"{row['peer_id']}:{moving}")
+        drv = _section(row, "placement_driver")
+        if drv:
+            driver = (row["peer_id"], drv)
+    if coact or mig_out or mig_fail or mig_inflight or driver:
+        lines.append("")
+        lines.append(
+            "PLACEMENT (gate co-activation, hottest pairs; HOME = hosting "
+            "peers):"
+        )
+        for key, n in sorted(
+            coact.items(), key=lambda kv: (-kv[1], kv[0])
+        )[:8]:
+            a, _, b = key.partition("|")
+            home_a = ",".join(sorted(homes.get(a, ()))) or "?"
+            home_b = ",".join(sorted(homes.get(b, ()))) or "?"
+            lines.append(
+                f"  {key:<44.44} {int(n):>8}  {home_a} | {home_b}"
+            )
+        mig = f"  migrations: {mig_out} completed, {mig_fail} failed"
+        if mig_inflight:
+            mig += f", in flight: {', '.join(sorted(mig_inflight))}"
+        lines.append(mig)
+        if driver is not None:
+            peer_id, drv = driver
+            moving = drv.get("in_flight")
+            lines.append(
+                f"  driver {peer_id}: "
+                f"{int(_num(drv.get('completed')))} completed, "
+                f"{int(_num(drv.get('failed')))} failed, "
+                f"{int(_num(drv.get('aborted_slo')))} aborted-by-SLO"
+                + (f", moving {moving}" if isinstance(moving, str) else "")
             )
     # span-level latency only exists on peers running LAH_PROFILE=1
     p99 = {}
