@@ -233,7 +233,7 @@ def run_dht_sim_bench(deadline: int = 420, sizes: str = "128,512") -> dict | Non
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "3b4075c"
+PREV_ROUND_REV = "a77e7cb"
 
 
 def check_orphan_servers() -> dict | None:
@@ -421,6 +421,13 @@ def main() -> int:
         gwb = run_gateway_bench()
         if gwb:
             result.update(gwb)
+        # self-speculative decode A/B (ISSUE 17): k NGram-drafted tokens
+        # verified through the paged KV in one batched swarm round vs
+        # token-at-a-time, swept over wire RTT x {greedy, seeded
+        # sampled} — host/DCN tier like the gateway bench
+        spc = run_spec_decode_bench()
+        if spc:
+            result.update(spc)
         # co-activation-aware placement A/B (ISSUE 16): clustered gate
         # over a split assignment with one chaos-slowed node, static vs
         # solver-optimized placement (migrations executed LIVE under
@@ -440,6 +447,15 @@ def main() -> int:
     # as a constant so graded artifacts carry the target curve the
     # placement/routing work is measured against.
     result["decode_gap_nats_by_experts"] = {"16": 0.336, "32": 0.568}
+    # the sampled path (ISSUE 17) inherits the same curve: gate
+    # affinities are computed from hidden states BEFORE the token is
+    # drawn, and speculative verify recomputes the exact per-position
+    # logits, so temperature/top-p/top-k cannot move the routing gap.
+    # Recorded explicitly so a sampling change that DID touch routing
+    # would have to update this line (standing quality thread).
+    result["decode_gap_nats_by_experts_sampled"] = {
+        "16": 0.336, "32": 0.568,
+    }
     if box_dirty:
         result.update(box_dirty)
     print(json.dumps(result), flush=True)
@@ -2150,6 +2166,190 @@ def run_gateway_bench(deadline: int = 560) -> dict | None:
     return result
 
 
+def spec_decode_worker() -> None:
+    """Self-speculative decode A/B (ISSUE 17 acceptance): the SAME swarm
+    model decodes the SAME prompts through the paged gateway with
+    ``spec_k=0`` (token-at-a-time) vs ``spec_k>0`` (NGram drafts
+    verified through the paged KV in ONE batched swarm round), swept
+    over wire RTT {LAN, WAN} x sampling {greedy, seeded sampled}.
+    Decode steps are wire-latency-bound (subprocess nop-expert servers
+    with injected reply latency, same isolation as the gateway bench),
+    and a verify round pays the SAME round-trip as a decode step but
+    can commit up to k+1 tokens — so per-stream tokens/sec scales with
+    the acceptance rate at WAN RTT and must sit in the noise at LAN
+    RTT, where the round-trip is no longer the bottleneck.  Prompts
+    are short repeating patterns: the tiny greedy model falls into the
+    degenerate loops the NGram drafter is built for, which is the
+    workload that shows the mechanism (acceptance is workload-dependent
+    by construction; the bench fixes the workload so the A/B isolates
+    the code path).  The sampled arms use the counter-based RNG at a
+    low temperature so the seeded streams stay near the greedy loop —
+    exercising verify-under-sampling without destroying acceptance."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(
+        int(os.environ.get("BENCH_DEADLINE_S", "420")), exit=True
+    )
+
+    import jax
+
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.gateway import Gateway, GatewayClient
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmDMoETransformerLM,
+        SwarmTransformerConfig,
+    )
+    from learning_at_home_tpu.utils.subproc import (
+        shutdown_procs,
+        spawn_expert_servers,
+    )
+
+    # max_new is deliberately long: the NGram drafter pays a warm-up of
+    # plain rounds until the model's output loop enters the context, so
+    # short streams under-report the steady-state win (24-token streams
+    # measured ~1.7 tokens/round-trip; 56-token streams let the locked
+    # drafter dominate)
+    d_model, n_layers, seq = 16, 2, 96
+    vocab, prompt_len, max_new = 64, 16, 56
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    n_requests = int(os.environ.get("BENCH_SPEC_REQUESTS", "3"))
+    lat_lan = float(os.environ.get("BENCH_SPEC_LAN_LATENCY", "0.002"))
+    # WAN regime: per-layer reply latency x n_layers ~ the >=40 ms
+    # decode-step round-trip the acceptance bar is stated against
+    lat_wan = float(os.environ.get("BENCH_SPEC_WAN_LATENCY", "0.02"))
+    out: dict = {
+        "spec_k": spec_k,
+        "spec_requests_per_arm": n_requests,
+        "spec_tokens_per_stream": max_new,
+        "spec_wan_step_rtt_s": round(lat_wan * n_layers, 4),
+    }
+
+    def prompt_for(i: int) -> list:
+        # period-4 repeating pattern, varied per request index; the
+        # SAME prompts drive every arm so on/off compare equal work
+        base = [(3 + i) % vocab, (9 + i) % vocab,
+                (4 + i) % vocab, (7 + i) % vocab]
+        return (base * ((prompt_len + 3) // 4))[:prompt_len]
+
+    for rtt_label, latency in (("lan", lat_lan), ("wan", lat_wan)):
+        prefix = f"sd{rtt_label[0]}"
+        procs, ports = spawn_expert_servers(
+            REPO, prefix, (latency,) * n_layers, d_model=d_model,
+            num_experts=2,
+        )
+        try:
+            source = StaticExpertSource({
+                f"{prefix}{layer}.{e}": ("127.0.0.1", ports[layer])
+                for layer in range(n_layers) for e in range(2)
+            })
+            cfg = SwarmTransformerConfig(
+                vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+                n_heads=4, seq_len=seq, grid_size=(2,), k_best=2,
+                k_min=2, uid_prefix=prefix, timeout_after_k_min=30.0,
+                forward_timeout=60.0, backward_timeout=60.0,
+                wire_codec="none", routing_cost_weight=0,
+            )
+            model = SwarmDMoETransformerLM(cfg, source)
+            params = model.init_params(jax.random.PRNGKey(0))
+            for mode in ("greedy", "sampled"):
+                for arm, k in (("off", 0), ("on", spec_k)):
+                    label = f"spec_{rtt_label}_{mode}_{arm}"
+                    with Gateway(
+                        model, params, max_slots=2, coalesce=True,
+                        spec_k=k,
+                        spec_drafter="ngram" if k else None,
+                    ) as gw:
+                        client = GatewayClient(gw.endpoint, timeout=60.0)
+                        # warm the decode path (jit + pools) off-clock
+                        client.generate(prompt_for(99), 2)
+                        served = 0
+                        t0 = time.monotonic()
+                        for i in range(n_requests):
+                            kw = (
+                                dict(seed=1000 + i, temperature=0.15,
+                                     top_k=4)
+                                if mode == "sampled" else {}
+                            )
+                            r = client.generate(
+                                prompt_for(i), max_new,
+                                deadline_s=300.0, **kw,
+                            )
+                            if r.get("error"):
+                                out[label + "_error"] = str(
+                                    r["error"]
+                                )[:200]
+                            served += len(r.get("tokens") or [])
+                        wall = time.monotonic() - t0
+                        s = gw.scheduler
+                        out[label + "_tokens"] = served
+                        out[label + "_tokens_per_sec"] = (
+                            round(served / wall, 2) if wall else 0.0
+                        )
+                        if k:
+                            out[label + "_verify_rounds"] = (
+                                s.spec_rounds_total
+                            )
+                            out[label + "_acceptance_rate"] = (
+                                round(s.spec_accepted_total
+                                      / s.spec_proposed_total, 3)
+                                if s.spec_proposed_total else 0.0
+                            )
+                            # effective tokens per swarm round-trip:
+                            # the unit the WAN speedup is made of
+                            out[label + "_tokens_per_roundtrip"] = (
+                                round(s.spec_tokens_total
+                                      / s.spec_rounds_total, 2)
+                                if s.spec_rounds_total else 0.0
+                            )
+        finally:
+            shutdown_procs(procs)
+            reset_client_rpc()
+        # partial print per RTT regime: a WAN failure must never
+        # forfeit the LAN half of the A/B
+        print(json.dumps(out), flush=True)
+
+    for rtt_label in ("lan", "wan"):
+        for mode in ("greedy", "sampled"):
+            off = out.get(f"spec_{rtt_label}_{mode}_off_tokens_per_sec")
+            on = out.get(f"spec_{rtt_label}_{mode}_on_tokens_per_sec")
+            out[f"spec_{rtt_label}_{mode}_speedup"] = (
+                round(on / off, 2) if off and on is not None else None
+            )
+    out["spec_wan_speedup_ge_2x"] = bool(
+        (out.get("spec_wan_greedy_speedup") or 0) >= 2.0
+        and (out.get("spec_wan_sampled_speedup") or 0) >= 2.0
+    )
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(out), flush=True)
+
+
+def run_spec_decode_bench(deadline: int = 420) -> dict | None:
+    """Speculative-decode A/B in a scrubbed CPU subprocess (host/DCN
+    tier, wire-latency-bound like the gateway bench)."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_DEADLINE_S"] = str(deadline)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--spec-decode-worker"],
+            capture_output=True, text=True, timeout=deadline + 30,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        print("bench: spec-decode bench timed out", file=sys.stderr)
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        return _last_json_line(stdout)
+    result = _last_json_line(r.stdout)
+    if result is None:
+        print(f"bench: spec-decode bench rc={r.returncode}, no JSON\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+    return result
+
+
 def averaging_worker() -> None:
     """Trainer-side averaging microbench: two in-process peers run
     ``--avg-rounds`` DHT-matched all-reduce rounds over a trunk-sized
@@ -2263,6 +2463,18 @@ if __name__ == "__main__":
     if "--placement-worker" in sys.argv:
         placement_worker()
         sys.exit(0)
+    if "--spec-decode-worker" in sys.argv:
+        spec_decode_worker()
+        sys.exit(0)
+    if "--spec-decode" in sys.argv:
+        # standalone speculative-decode A/B (ISSUE 17): RTT x sampling
+        # x spec on/off sweep, in the same scrubbed subprocess the full
+        # bench uses
+        _spc = run_spec_decode_bench()
+        print(json.dumps(
+            _spc if _spc else {"error": "spec-decode bench failed"}
+        ), flush=True)
+        sys.exit(0 if _spc else 1)
     if "--placement-bench" in sys.argv:
         # standalone placement A/B (ISSUE 16): clustered-coactivation
         # static-vs-optimized series with live migrations under load,

@@ -27,7 +27,13 @@ Workload shaping (ISSUE 13 — the paged-KV/chunked-prefill A/B knobs):
   a request's first ``min(prefix_len, len-1)`` tokens are one fixed
   seed-derived shared prefix (total length still comes from the bucket,
   so prefix on/off A/Bs compare equal-length work) — the shared-prefix
-  workload the gateway's content-addressed prefix cache accelerates.
+  workload the gateway's content-addressed prefix cache accelerates;
+- ``temperature`` / ``top_p`` / ``top_k`` / ``sample_seed`` (ISSUE 17)
+  — per-request sampling knobs forwarded as optional ``gen_submit``
+  fields.  Request *i* samples under seed ``sample_seed + i``, so a
+  rerun at the same base seed replays token-identical sampled streams
+  (the gateway's counter-based RNG); all-None keeps greedy requests
+  with no sampling fields on the wire.
 
 Importable (``run_load``) for bench.py / collect_gate.py, or a CLI::
 
@@ -89,6 +95,10 @@ def run_load(
     prompt_len_dist: list = None,
     prefix_share: float = 0.0,
     prefix_len: int = 0,
+    temperature: float = None,
+    top_p: float = None,
+    top_k: int = None,
+    sample_seed: int = None,
 ) -> dict:
     """Drive one gateway open-loop and return the JSON-ready report.
 
@@ -119,6 +129,11 @@ def run_load(
         "errors": 0, "crashes": 0, "tokens_served": 0,
         "prefix_share": float(prefix_share), "prefix_len": int(prefix_len),
     }
+    if any(v is not None for v in (temperature, top_p, top_k, sample_seed)):
+        report["sampling"] = {
+            "temperature": temperature, "top_p": top_p, "top_k": top_k,
+            "sample_seed": sample_seed,
+        }
     ttfts: list[float] = []
     itls: list[float] = []
     buckets = {
@@ -128,7 +143,7 @@ def run_load(
     }
     threads: list[threading.Thread] = []
 
-    def one_request(prompt, n_new, bucket) -> None:
+    def one_request(prompt, n_new, bucket, req_seed) -> None:
         token_times: list[float] = []
         t_submit = time.monotonic()
         try:
@@ -137,6 +152,8 @@ def run_load(
                 poll_interval_s=poll_interval_s,
                 deadline_s=drain_timeout_s,
                 on_token=token_times.append,
+                seed=req_seed, temperature=temperature,
+                top_p=top_p, top_k=top_k,
             )
         except Exception:
             with lock:
@@ -184,8 +201,16 @@ def run_load(
             k = min(len(shared_prefix), p_len - 1)
             if k > 0:
                 prompt = shared_prefix[:k] + prompt[k:]
+        # per-arrival sampling seed: decorrelated streams, reproducible
+        # per (sample_seed, arrival index) — two runs at the same seed
+        # replay token-identical sampled streams (counter-based RNG)
+        req_seed = (
+            int(sample_seed) + report["arrivals"]
+            if sample_seed is not None else None
+        )
         th = threading.Thread(
-            target=one_request, args=(prompt, n_new, name), daemon=True
+            target=one_request, args=(prompt, n_new, name, req_seed),
+            daemon=True,
         )
         th.start()
         threads.append(th)
@@ -250,6 +275,18 @@ def main(argv=None) -> int:
                     metavar=("MIN", "MAX"))
     ap.add_argument("--vocab", type=int, default=258)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--temperature", type=float, default=None,
+                    help="sampling temperature for every request "
+                         "(default: greedy — no sampling fields on the "
+                         "wire at all)")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="nucleus-sampling mass (requires temperature)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="top-k truncation (requires temperature)")
+    ap.add_argument("--sample-seed", type=int, default=None,
+                    help="base sampling seed; request i uses "
+                         "sample-seed + i, so reruns replay "
+                         "token-identical sampled streams")
     args = ap.parse_args(argv)
     host, _, port = args.endpoint.rpartition(":")
     if not port.isdigit():
@@ -268,6 +305,10 @@ def main(argv=None) -> int:
         ),
         prefix_share=args.prefix_share,
         prefix_len=args.prefix_len,
+        temperature=args.temperature,
+        top_p=args.top_p,
+        top_k=args.top_k,
+        sample_seed=args.sample_seed,
     )
     print(json.dumps(report))
     return 0

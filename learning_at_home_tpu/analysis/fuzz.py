@@ -9,8 +9,9 @@ frames per handler family:
 
 - **meta mutations** — drop each required field (the server must reject:
   error reply or clean close, never a ``result``), retype fields to the
-  wrong msgpack type, oversize string/bytes/int values, replace the
-  whole meta map with a non-map;
+  wrong msgpack type, oversize string/bytes/int values, hostile float
+  values (NaN / inf / negative — the ISSUE-17 sampling knobs), replace
+  the whole meta map with a non-map;
 - **frame mutations** — truncated payloads (outer length prefix lies
   long), inner header-length lies, non-msgpack headers, tensor specs
   whose declared byte counts disagree with the payload, rid games
@@ -268,6 +269,17 @@ def _meta_cases(family: str, op: str, fields: dict, rng: Random):
             big = dict(base)
             big[fname] = 1 << 62
             yield case(f"oversize:{fname}", "oversize", "tolerate", big)
+        elif t == "float":
+            # value-level hostility for float fields (sampling knobs):
+            # non-finite and out-of-range values must come back as
+            # well-formed frames, never decoder state or a wedged loop
+            for label, val in (("nan", float("nan")),
+                               ("inf", float("inf")),
+                               ("neg", -1.0)):
+                hostile = dict(base)
+                hostile[fname] = val
+                yield case(f"hostile-{label}:{fname}", "hostile_value",
+                           "tolerate", hostile)
     # whole-meta shapes
     yield case("meta-str", "meta_not_map", "tolerate", "junk")
     yield case("meta-list", "meta_not_map", "tolerate", [1, 2, 3])

@@ -38,9 +38,12 @@ default).  The first term rewards co-locating experts that fire
 together (one node touched per dispatch instead of two); the second
 pulls hot experts toward nodes the dispatching clients reach cheaply.
 
-The solver is seeded greedy local search over single-expert moves under
-per-node capacity: deterministic for a fixed (snapshot, seed) — ties
-break on sorted keys, the visit order is `random.Random(seed)`.
+The solver is seeded greedy local search over two neighborhoods under
+per-node capacity: single-expert moves, then pair swaps (exchanging two
+experts' homes — occupancy-neutral, so always capacity-safe: the escape
+hatch when every profitable single move is blocked by a full node).
+Deterministic for a fixed (snapshot, seed) — ties break on sorted keys,
+the visit order is `random.Random(seed)`.
 """
 
 from __future__ import annotations
@@ -257,6 +260,33 @@ def solve(
                 model.occupancy[best] += 1
                 moved.add(uid)
                 improved = True
+        # pair-swap neighborhood: exchanging two experts' homes leaves
+        # every node's occupancy unchanged, so a swap is capacity-safe
+        # even between FULL nodes — the configurations single moves can
+        # never reach under tight caps
+        pairs = [
+            (uids[i], uids[j])
+            for i in range(len(uids))
+            for j in range(i + 1, len(uids))
+        ]
+        rng.shuffle(pairs)
+        for u, v in pairs:
+            nu, nv = model.assign[u], model.assign[v]
+            if nu == nv:
+                continue
+            if len(moved | {u, v}) > max_moves:
+                continue
+            before = model.expert_cost(u, nu) + model.expert_cost(v, nv)
+            model.assign[u], model.assign[v] = nv, nu
+            after = model.expert_cost(u, nv) + model.expert_cost(v, nu)
+            # when u,v co-activate their shared pair term sits in both
+            # sums on both sides and links are symmetric, so it cancels
+            # — the delta over everything else is exact
+            if after < before - 1e-12:
+                moved.update((u, v))
+                improved = True
+            else:
+                model.assign[u], model.assign[v] = nu, nv
         if not improved:
             break
     moves = []

@@ -304,6 +304,8 @@ class _FakePagedDecoder:
         self.prefills_total = 0
         self.prefill_chunks_total = 0
         self.decode_steps_total = 0
+        self.verify_rounds_total = 0
+        self.last_verify: list = []
 
     # slot bookkeeping — same shapes as SwarmKVDecoder
     def free_slots(self):
@@ -350,7 +352,8 @@ class _FakePagedDecoder:
         # deterministic pseudo-token from the slot's position
         return int(self.pos[slot]) * 7 % 251
 
-    def begin_prefill(self, slot, prompt_ids, stream_id=None) -> int:
+    def begin_prefill(self, slot, prompt_ids, stream_id=None,
+                      sampling=None) -> int:
         if self.live[slot] or self.prefilling[slot]:
             raise ValueError(f"slot {slot} is occupied")
         prompt = [int(t) for t in prompt_ids]
@@ -423,6 +426,89 @@ class _FakePagedDecoder:
                 self.pos[s] += 1
         self.decode_steps_total += 1
         return nxt
+
+    # speculative contract — same shapes as SwarmKVDecoder, the trunk
+    # replaced by the _tok arithmetic.  The PagedKVCache underneath is
+    # REAL, so ensure_lookahead_pages allocates genuine pool pages and
+    # verify_step's rollback runs the production truncate_slot with its
+    # inline kv.rollback_private_only check.
+
+    def ensure_lookahead_pages(self, slot, k) -> int:
+        from learning_at_home_tpu.models.kv_pages import PagePressure
+
+        pos = int(self.pos[slot])
+        top = min(pos + int(k), self.seq_len - 1)
+        want = top // self.kv.page_len
+        while int(self.kv.alloc_count[slot]) <= want:
+            try:
+                self.kv.alloc_slot_page(slot)
+            except PagePressure:
+                break
+        covered = int(self.kv.alloc_count[slot]) * self.kv.page_len - 1
+        return max(0, min(int(k), covered - pos))
+
+    def verify_step(self, proposals: dict) -> dict:
+        if not proposals:
+            return {}
+        out: dict = {}
+        self.last_verify = []
+        for s in sorted(int(x) for x in proposals):
+            if not self.live[s]:
+                raise ValueError(f"slot {s} is not live")
+            drafts = [int(t) for t in proposals[s]]
+            pos = int(self.pos[s])
+            if pos + len(drafts) > self.seq_len - 1:
+                raise ValueError(
+                    f"slot {s}: {len(drafts)} drafts at position {pos} "
+                    f"exceed the cache ({self.seq_len} positions)"
+                )
+            want = (pos + len(drafts)) // self.kv.page_len
+            if int(self.kv.alloc_count[s]) <= want:
+                raise ValueError(
+                    f"slot {s} has no KV page for its lookahead — "
+                    "call ensure_lookahead_pages() first"
+                )
+            # row j's sample is exactly what decode_step would emit at
+            # position pos+j under the token arithmetic
+            samples = [
+                (pos + j) * 7 % 251 for j in range(len(drafts) + 1)
+            ]
+            a = 0
+            while a < len(drafts) and drafts[a] == samples[a]:
+                a += 1
+            tokens = samples[:a + 1]
+            self.pos[s] = pos + a + 1
+            self.kv.truncate_slot(s, int(self.pos[s]))
+            out[s] = {
+                "tokens": tokens, "accepted": a, "proposed": len(drafts)
+            }
+            self.last_verify.append({
+                "slot": s, "stream_id": self.stream_ids[s],
+                "drafts": drafts, "samples": samples,
+                "accepted": a, "tokens": list(tokens),
+            })
+        self.verify_rounds_total += 1
+        return out
+
+
+class _FakeMixedDrafter:
+    """Drafter for the speculative gateway world: proposes against the
+    fake decoder's token arithmetic, deterministically mixing rounds of
+    full acceptance with rounds that go wrong at every possible depth —
+    so exploration drives accepted prefixes of 0..k and every verify
+    round exercises both spec_prefix_accept and the truncate_slot
+    rollback underneath."""
+
+    def propose(self, context, k, sampling=None):
+        # the fake decoder's invariant: pos = len(context) - 1, so the
+        # sample verify row j emits is (pos + j) * 7 % 251
+        pos = len(context) - 1
+        correct = [(pos + j) * 7 % 251 for j in range(int(k))]
+        wrong_at = len(context) % (int(k) + 1)  # varies per round
+        return [
+            t if j < wrong_at else (t + 1) % 251
+            for j, t in enumerate(correct)
+        ]
 
 
 # ---- mechanically reverted PR-13 scheduler code (seeded bugs) ----
@@ -574,7 +660,7 @@ class _GatewayWorld:
 
     def __init__(self, *, seeded_bug: Optional[str] = None,
                  prefix_cache: bool = False, with_cancel: bool = False,
-                 iterations: int = 10):
+                 speculative: bool = False, iterations: int = 10):
         from learning_at_home_tpu.gateway import scheduler as sched_mod
         from learning_at_home_tpu.gateway.admission import (
             AdmissionController,
@@ -587,13 +673,30 @@ class _GatewayWorld:
         self._clock = _VirtualClock(step=0.001)
         self._saved_monotonic = sched_mod._monotonic
         sched_mod._monotonic = self._clock
-        decoder = _FakePagedDecoder(
-            max_slots=2, seq_len=8, page_len=2, num_pages=5,
-            prefix_cache=prefix_cache,
-        )
+        # the speculative world gets a deeper cache: under the 8/5 shape
+        # every k=2 lookahead needs the slot's 4th page, which the pool
+        # can never spare, so ensure_lookahead_pages would clamp every
+        # draft to zero and verify rounds degrade to plain decode rows.
+        # 12 positions / 8 pages let drafts through (mixed accept and
+        # reject-with-rollback rounds) while two full-depth streams
+        # still overcommit the pool (5+5 > 8), keeping the pressure,
+        # preemption and clamp paths exercised.
+        if speculative:
+            self.name = "gateway-spec"
+            decoder = _FakePagedDecoder(
+                max_slots=2, seq_len=12, page_len=2, num_pages=8,
+                prefix_cache=prefix_cache,
+            )
+        else:
+            decoder = _FakePagedDecoder(
+                max_slots=2, seq_len=8, page_len=2, num_pages=5,
+                prefix_cache=prefix_cache,
+            )
         self.sched = SlotScheduler(
             decoder, idle_wait_s=0.0, stream_ttl_s=1000.0,
             prefill_chunk_tokens=2,
+            spec_k=2 if speculative else 0,
+            drafter=_FakeMixedDrafter() if speculative else None,
         )
         self.admission = AdmissionController(self.sched, max_pending=2)
         if seeded_bug == "stale-prefill":
@@ -725,11 +828,12 @@ class _GatewayWorld:
 def explore_gateway(*, seed: int = 0, max_schedules: int = 200,
                     seeded_bug: Optional[str] = None,
                     with_cancel: bool = False,
-                    prefix_cache: bool = False) -> ExplorationResult:
+                    prefix_cache: bool = False,
+                    speculative: bool = False) -> ExplorationResult:
     return explore(
         lambda: _GatewayWorld(
             seeded_bug=seeded_bug, with_cancel=with_cancel,
-            prefix_cache=prefix_cache,
+            prefix_cache=prefix_cache, speculative=speculative,
         ),
         seed=seed, max_schedules=max_schedules,
     )
@@ -1241,6 +1345,8 @@ def run_all(*, seed: int = 0, max_schedules: int = 200) -> dict:
                         with_cancel=True),
         explore_gateway(seed=seed, max_schedules=max_schedules // 2,
                         prefix_cache=True),
+        explore_gateway(seed=seed, max_schedules=max_schedules // 2,
+                        speculative=True),
         explore_lifecycle(seed=seed, max_schedules=max_schedules),
         explore_migration(seed=seed, max_schedules=max_schedules),
         check_handoff_receiver(seed=seed),

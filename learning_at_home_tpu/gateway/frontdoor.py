@@ -8,9 +8,11 @@ unchanged.  All gateway ops are meta-only control frames (token ids ride
 in msgpack meta, never as tensors — a generate stream moves a few ints
 per poll, not megabyte activations):
 
-- ``gen_submit`` {prompt: [int], max_new_tokens} →
-  {"accepted": true, "sid"} or
+- ``gen_submit`` {prompt: [int], max_new_tokens, seed?, temperature?,
+  top_p?, top_k?} → {"accepted": true, "sid"} or
   {"accepted": false, "shed": true, "retry_after_s", "message"}
+  (the four optional sampling fields select counter-based sampled
+  decoding; all absent = greedy, the legacy wire shape unchanged)
 - ``gen_poll``   {sid, cursor} → {"tokens": [int], "cursor", "done",
   "error"?} (tokens from ``cursor`` on; poll again from the returned
   cursor — replies are immediate, never held)
@@ -39,6 +41,11 @@ from typing import Optional
 from learning_at_home_tpu.gateway.admission import AdmissionController
 from learning_at_home_tpu.gateway.coalesce import ExpertCoalescer
 from learning_at_home_tpu.gateway.scheduler import SlotScheduler
+from learning_at_home_tpu.models.drafter import (
+    NGramDrafter,
+    TruncatedTrunkDrafter,
+)
+from learning_at_home_tpu.models.sampling import SamplingParams
 from learning_at_home_tpu.models.swarm_decoder import SwarmKVDecoder
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
 from learning_at_home_tpu.utils.serialization import (
@@ -91,6 +98,8 @@ class Gateway:
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
         prefill_chunk_tokens: Optional[int] = None,
+        spec_k: Optional[int] = None,
+        spec_drafter: Optional[str] = None,
     ):
         self.model = model
         self.coalescer = ExpertCoalescer(coalesce=coalesce)
@@ -108,9 +117,33 @@ class Gateway:
             kv_layout=kv_layout, page_len=page_len, num_pages=num_pages,
             prefix_cache=prefix_cache,
         )
+        # speculative decode: k drafted tokens verified per swarm
+        # round-trip (LAH_GW_SPEC_K=0 keeps the token-at-a-time loop)
+        if spec_k is None:
+            try:
+                spec_k = int(os.environ.get("LAH_GW_SPEC_K", "0"))
+            except ValueError:
+                spec_k = 0
+        spec_k = max(0, int(spec_k))
+        drafter = None
+        if spec_k > 0:
+            if spec_drafter is None:
+                spec_drafter = os.environ.get(
+                    "LAH_GW_SPEC_DRAFTER", "ngram"
+                )
+            if spec_drafter == "trunk":
+                drafter = TruncatedTrunkDrafter(model, params)
+            elif spec_drafter == "ngram":
+                drafter = NGramDrafter()
+            else:
+                raise ValueError(
+                    f"spec_drafter must be 'ngram' or 'trunk', got "
+                    f"{spec_drafter!r}"
+                )
         self.scheduler = SlotScheduler(
             self.decoder, stream_ttl_s=stream_ttl_s,
             prefill_chunk_tokens=prefill_chunk_tokens,
+            spec_k=spec_k, drafter=drafter,
         )
         # server-load feed: the MoE's own cost model already TTL-caches
         # the load.<prefix> heartbeats (PR 8) — reuse it instead of
@@ -216,6 +249,15 @@ class Gateway:
             "lah_gateway_preemptions_total": s.preemptions_total,
             "lah_gateway_prefill_chunks_total":
                 self.decoder.prefill_chunks_total,
+            "lah_gateway_spec_k": s.spec_k if s.speculative else 0,
+            "lah_gateway_spec_rounds_total": s.spec_rounds_total,
+            "lah_gateway_spec_proposed_total": s.spec_proposed_total,
+            "lah_gateway_spec_accepted_total": s.spec_accepted_total,
+            "lah_gateway_spec_tokens_total": s.spec_tokens_total,
+            "lah_gateway_spec_draft_seconds_total":
+                s.spec_draft_seconds_total,
+            "lah_gateway_spec_verify_seconds_total":
+                s.spec_verify_seconds_total,
         }
         kv = self.decoder.kv
         if kv is not None:
@@ -230,6 +272,8 @@ class Gateway:
                 "lah_gateway_cow_copies_total": kv.cow_copies_total,
                 "lah_gateway_kv_pages_reclaimed_total":
                     kv.pages_reclaimed_total,
+                "lah_gateway_kv_rollback_pages_total":
+                    kv.rollback_pages_total,
             })
         return out
 
@@ -374,6 +418,42 @@ class Gateway:
             or max_new < 1
         ):
             raise ValueError("max_new_tokens must be a positive int")
+        # optional counter-based sampling fields — any present field
+        # turns the stream sampled; hostile values (bools, NaN, out of
+        # range) become well-formed error frames, never decoder state
+        sampling = None
+        seed = meta.get("seed")
+        temperature = meta.get("temperature")
+        top_p = meta.get("top_p")
+        top_k = meta.get("top_k")
+        if any(v is not None for v in (seed, temperature, top_p, top_k)):
+            if seed is None:
+                seed = 0
+            if not isinstance(seed, int) or isinstance(seed, bool):
+                raise ValueError("seed must be an int")
+            if temperature is None:
+                temperature = 0.0
+            if isinstance(temperature, bool) or not isinstance(
+                temperature, (int, float)
+            ):
+                raise ValueError("temperature must be a number")
+            if top_p is None:
+                top_p = 1.0
+            if isinstance(top_p, bool) or not isinstance(
+                top_p, (int, float)
+            ):
+                raise ValueError("top_p must be a number")
+            if top_k is None:
+                top_k = 0
+            if not isinstance(top_k, int) or isinstance(top_k, bool):
+                raise ValueError("top_k must be an int")
+            # range validation (finite temperature >= 0, top_p in
+            # (0, 1], top_k >= 0, seed in [0, 2**63)) lives in
+            # SamplingParams and raises ValueError too
+            sampling = SamplingParams(
+                seed=seed, temperature=float(temperature),
+                top_p=float(top_p), top_k=top_k,
+            )
         # an over-long prompt is a well-formed error frame BEFORE the
         # stream table sees it — it must never reach the decode thread,
         # where it could only crash prefill or wedge the pending queue
@@ -384,7 +464,15 @@ class Gateway:
                 f"(cache holds {self.decoder.seq_len} positions)"
             )
         max_new = min(max_new, capacity)
-        pages_needed = self.decoder.pages_needed(len(prompt), max_new)
+        # k-aware slot accounting: a speculative stream's peak page use
+        # includes up to spec_k lookahead positions past its budget
+        # (rolled back after rejection, but mapped at the peak)
+        spec_k = (
+            self.scheduler.spec_k if self.scheduler.speculative else 0
+        )
+        pages_needed = self.decoder.pages_needed(
+            len(prompt), max_new + spec_k
+        )
         if (
             self.decoder.kv is not None
             and self.decoder.pages_needed(len(prompt) + 1)
@@ -405,7 +493,7 @@ class Gateway:
                 "retry_after_s": retry_after_s,
                 "message": reason,
             }
-        sid = self.scheduler.submit(prompt, max_new)
+        sid = self.scheduler.submit(prompt, max_new, sampling=sampling)
         return {"accepted": True, "sid": sid}
 
 
@@ -427,15 +515,27 @@ class GatewayClient:
         )
         return reply or {}
 
-    def submit(self, prompt, max_new_tokens: int) -> dict:
+    def submit(self, prompt, max_new_tokens: int, *,
+               seed=None, temperature=None, top_p=None,
+               top_k=None) -> dict:
         """One admission attempt; the reply is either accepted ({sid}) or
         a shed ({shed, retry_after_s}).  Raises RemoteCallError only for
-        INVALID requests — backpressure is a normal reply."""
-        return self._rpc(
-            "gen_submit",
-            {"prompt": [int(t) for t in prompt],
-             "max_new_tokens": int(max_new_tokens)},
-        )
+        INVALID requests — backpressure is a normal reply.  The sampling
+        kwargs ride as optional gen_submit fields (all None = greedy,
+        and the wire frame carries no sampling keys at all)."""
+        meta = {
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+        }
+        if seed is not None:
+            meta["seed"] = int(seed)
+        if temperature is not None:
+            meta["temperature"] = float(temperature)
+        if top_p is not None:
+            meta["top_p"] = float(top_p)
+        if top_k is not None:
+            meta["top_k"] = int(top_k)
+        return self._rpc("gen_submit", meta)
 
     def poll(self, sid: str, cursor: int = 0) -> dict:
         return self._rpc("gen_poll", {"sid": sid, "cursor": int(cursor)})
@@ -454,11 +554,18 @@ class GatewayClient:
         poll_interval_s: float = 0.005,
         deadline_s: float = 120.0,
         on_token=None,
+        seed=None,
+        temperature=None,
+        top_p=None,
+        top_k=None,
     ) -> dict:
         """Submit once and poll to completion.  Returns
         ``{"tokens", "shed", "retry_after_s"?, "error"?}`` — a shed
         returns immediately (open-loop callers own the retry policy)."""
-        sub = self.submit(prompt, max_new_tokens)
+        sub = self.submit(
+            prompt, max_new_tokens,
+            seed=seed, temperature=temperature, top_p=top_p, top_k=top_k,
+        )
         if not sub.get("accepted"):
             return {
                 "tokens": [],

@@ -22,12 +22,23 @@ continuous-batching policy:
 5. streams that hit their token budget or cache capacity vacate their
    slot immediately.
 
+With ``spec_k > 0`` and a drafter, step 4 becomes a **speculative
+verify round** instead: each live stream's drafter proposes up to
+``spec_k`` continuation tokens from its committed context, and ONE
+batched :meth:`~learning_at_home_tpu.models.swarm_decoder.
+SwarmKVDecoder.verify_step` checks every drafted position for every
+stream in a single trunk pass — one coalesced expert fan-out per layer
+buys up to ``spec_k + 1`` tokens per stream per round-trip, with
+output token-identical to non-speculative decoding (the counter-based
+RNG makes acceptance an exact recomputation, models/sampling.py).
+
 Page pressure (paged layout only) is resolved by **preemption and
 recompute**: the youngest stream that cannot get a page is evicted and
 requeued at the FRONT of the pending queue with an effective prompt of
-``prompt + tokens-so-far`` — greedy decoding makes the recomputed
-continuation token-identical, so clients only ever observe added
-latency, never changed output.
+``prompt + tokens-so-far`` — counter-based (seed, position) decoding
+makes the recomputed continuation token-identical for greedy and
+sampled streams alike, so clients only ever observe added latency,
+never changed output.
 
 Everything the FRONT DOOR touches (the stream table, the pending queue,
 per-stream token buffers) is guarded by the ``gateway.streams`` lock with
@@ -55,6 +66,7 @@ logger = logging.getLogger(__name__)
 
 _DEFAULT_STREAM_TTL_S = 600.0
 _DEFAULT_PREFILL_CHUNK = 32
+_DEFAULT_SPEC_K = 0  # speculative decode off unless opted in
 
 
 def _monotonic() -> float:
@@ -83,6 +95,11 @@ VERIFIED_INVARIANTS = (
     ("scheduler.quiesce_baseline",
      "at scheduler idle (no open streams, empty queue) no slot is in "
      "use and the KV page pool accounting is internally consistent"),
+    ("scheduler.spec_prefix_accept",
+     "a speculative verify round commits exactly the longest matched "
+     "draft prefix plus the bonus sample — never a token at or past "
+     "the first mismatch (recomputed from the decoder's last_verify "
+     "record on every audit)"),
 )
 
 
@@ -97,6 +114,7 @@ class StreamState:
     cancelled: bool = False
     slot: Optional[int] = None
     prefilling: bool = False
+    sampling: Optional[object] = None  # SamplingParams (None = greedy)
     submitted_at: float = dataclasses.field(
         default_factory=lambda: _monotonic()
     )
@@ -114,6 +132,8 @@ class SlotScheduler:
         idle_wait_s: float = 0.02,
         stream_ttl_s: Optional[float] = None,
         prefill_chunk_tokens: Optional[int] = None,
+        spec_k: Optional[int] = None,
+        drafter=None,
     ):
         self.decoder = decoder
         self.idle_wait_s = idle_wait_s
@@ -141,6 +161,18 @@ class SlotScheduler:
             self.decoder.supports_chunked_prefill
             and self.prefill_chunk_tokens > 0
         )
+        if spec_k is None:
+            try:
+                spec_k = int(
+                    os.environ.get("LAH_GW_SPEC_K", str(_DEFAULT_SPEC_K))
+                )
+            except ValueError:
+                spec_k = _DEFAULT_SPEC_K
+        self.spec_k = max(0, int(spec_k))
+        self.drafter = drafter
+        # speculation needs both a positive k and someone to draft;
+        # either missing keeps decode_step as the exact legacy path
+        self.speculative = self.spec_k > 0 and drafter is not None
         self._lock = sanitizer.lock("gateway.streams")
         self._streams: dict[str, StreamState] = {}
         self._pending: deque[str] = deque()
@@ -157,6 +189,14 @@ class SlotScheduler:
         self.streams_cancelled_total = 0
         self.tokens_total = 0
         self.preemptions_total = 0
+        # speculative-decode counters (acceptance rate = accepted /
+        # proposed; effective k = tokens / rounds)
+        self.spec_rounds_total = 0
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_tokens_total = 0
+        self.spec_draft_seconds_total = 0.0
+        self.spec_verify_seconds_total = 0.0
         # decode-step wall time EMA (seconds) — the admission controller's
         # retry-after scale
         self.step_time_ema: Optional[float] = None
@@ -190,12 +230,15 @@ class SlotScheduler:
 
     # ---- front-door surface (any thread/loop; short lock sections) ----
 
-    def submit(self, prompt, max_new_tokens: int) -> str:
+    def submit(self, prompt, max_new_tokens: int, sampling=None) -> str:
         """Enqueue a stream; returns its sid.  Admission (shed/accept) is
-        the caller's job — this never refuses."""
+        the caller's job — this never refuses.  ``sampling`` is an
+        optional :class:`~learning_at_home_tpu.models.sampling.
+        SamplingParams` (None = greedy)."""
         sid = f"s{next(self._sid_counter)}-{self._sid_salt}"
         st = StreamState(
-            sid=sid, prompt=list(prompt), max_new_tokens=int(max_new_tokens)
+            sid=sid, prompt=list(prompt),
+            max_new_tokens=int(max_new_tokens), sampling=sampling,
         )
         with self._lock:
             self._streams[sid] = st
@@ -289,6 +332,23 @@ class SlotScheduler:
                 ),
                 "prefill_chunks_total": self.decoder.prefill_chunks_total,
                 "preemptions_total": self.preemptions_total,
+                "spec_k": self.spec_k if self.speculative else 0,
+                "spec_rounds_total": self.spec_rounds_total,
+                "spec_proposed_total": self.spec_proposed_total,
+                "spec_accepted_total": self.spec_accepted_total,
+                "spec_tokens_total": self.spec_tokens_total,
+                "spec_draft_seconds_total": round(
+                    self.spec_draft_seconds_total, 6
+                ),
+                "spec_verify_seconds_total": round(
+                    self.spec_verify_seconds_total, 6
+                ),
+                "spec_acceptance_rate": round(
+                    self.spec_accepted_total / self.spec_proposed_total, 4
+                ) if self.spec_proposed_total else 0.0,
+                "spec_effective_k": round(
+                    self.spec_tokens_total / self.spec_rounds_total, 4
+                ) if self.spec_rounds_total else 0.0,
             }
         out.update(self.decoder.kv_stats())
         return out
@@ -359,9 +419,10 @@ class SlotScheduler:
 
     def _effective_prompt(self, st: StreamState) -> list:
         """What prefill must run for st: the submitted prompt plus every
-        token already delivered (non-empty after a preemption — greedy
-        decoding makes the recomputed continuation identical, so the
-        requeue is invisible to the client beyond latency)."""
+        token already delivered (non-empty after a preemption — the
+        counter-based (seed, position) RNG makes the recomputed
+        continuation identical for greedy and sampled streams alike, so
+        the requeue is invisible to the client beyond latency)."""
         with self._lock:
             return list(st.prompt) + [int(t) for t in st.tokens]
 
@@ -416,7 +477,8 @@ class SlotScheduler:
             if self.chunked:
                 try:
                     self.decoder.begin_prefill(
-                        free[0], prompt, stream_id=st.sid
+                        free[0], prompt, stream_id=st.sid,
+                        sampling=st.sampling,
                     )
                 except PagePressure:
                     # not even the prefix-cache boundary copy fits right
@@ -438,7 +500,8 @@ class SlotScheduler:
             # legacy bench arm)
             try:
                 tok = self.decoder.prefill_into_slot(
-                    free[0], prompt, stream_id=st.sid
+                    free[0], prompt, stream_id=st.sid,
+                    sampling=st.sampling,
                 )
             except PagePressure:
                 self.decoder.evict(free[0])
@@ -577,6 +640,8 @@ class SlotScheduler:
         live = self.decoder.live_slots()
         if not live:
             return False
+        if self.speculative:
+            return self._verify_once(now, live)
         t0 = _monotonic()
         try:
             nxt = self.decoder.decode_step()
@@ -608,6 +673,103 @@ class SlotScheduler:
                     continue
                 st.tokens.append(int(nxt[slot]))
                 self.tokens_total += 1
+                if (
+                    len(st.tokens) >= st.max_new_tokens
+                    or self.decoder.at_capacity(slot)
+                    or st.cancelled
+                ):
+                    finished.append((slot, st))
+        for slot, st in finished:
+            if st is None:
+                self.decoder.evict(slot)
+            else:
+                self._finish(st, now, cancelled=st.cancelled)
+        return True
+
+    def _verify_once(self, now: float, live: list) -> bool:
+        """One speculative round: draft up to ``spec_k`` tokens per live
+        stream, verify every drafted position for every stream in ONE
+        batched trunk pass, commit the accepted prefixes.  Replaces the
+        single :meth:`decode_step` of the non-speculative loop — an
+        empty proposal (drafter found nothing, or no budget/capacity
+        headroom) degrades that stream to a plain decode row, so the
+        round always advances every stream by at least one token."""
+        proposals: dict[int, list] = {}
+        t_draft = _monotonic()
+        for slot, sid in live:
+            with self._lock:
+                st = self._streams.get(sid)
+                if st is None or st.slot != slot:
+                    remaining = 1  # advance the orphan row; cleaned below
+                    sampling = None
+                    ctx = None
+                else:
+                    remaining = st.max_new_tokens - len(st.tokens)
+                    sampling = st.sampling
+                    ctx = list(st.prompt) + [int(t) for t in st.tokens]
+            # a round delivers 1..k+1 tokens: cap k so the budget and
+            # the cache row at the last drafted position both exist
+            k = min(
+                self.spec_k,
+                max(0, remaining - 1),
+                self.decoder.seq_len - 1 - int(self.decoder.pos[slot]),
+            )
+            drafts: list = []
+            if k > 0 and ctx is not None:
+                try:
+                    drafts = [
+                        int(t)
+                        for t in self.drafter.propose(ctx, k, sampling)
+                    ][:k]
+                except Exception:
+                    logger.exception(
+                        "drafter failed for stream %s — plain decode", sid
+                    )
+                    drafts = []
+            if drafts:
+                covered = self.decoder.ensure_lookahead_pages(
+                    slot, len(drafts)
+                )
+                drafts = drafts[:covered]
+            proposals[slot] = drafts
+        self.spec_draft_seconds_total += _monotonic() - t_draft
+        t0 = _monotonic()
+        try:
+            results = self.decoder.verify_step(proposals)
+        except Exception as e:
+            logger.exception("verify step failed — erroring %d streams",
+                             len(live))
+            for _slot, sid in live:
+                with self._lock:
+                    st = self._streams.get(sid)
+                if st is not None:
+                    self._finish(st, now, error=f"{type(e).__name__}: {e}")
+            return True
+        dt = _monotonic() - t0
+        self.spec_verify_seconds_total += dt
+        self.step_time_ema = (
+            dt if self.step_time_ema is None
+            else 0.8 * self.step_time_ema + 0.2 * dt
+        )
+        finished = []
+        with self._lock:
+            for slot, sid in live:
+                st = self._streams.get(sid)
+                if st is None:  # GC'd mid-flight: free the slot below
+                    finished.append((slot, None))
+                    continue
+                if st.slot != slot:  # preempted within this pass
+                    continue
+                res = results.get(slot)
+                if res is None:
+                    continue
+                self.spec_rounds_total += 1
+                self.spec_proposed_total += res["proposed"]
+                self.spec_accepted_total += res["accepted"]
+                self.spec_tokens_total += len(res["tokens"])
+                for tok in res["tokens"]:
+                    st.tokens.append(int(tok))
+                    self.tokens_total += 1
                 if (
                     len(st.tokens) >= st.max_new_tokens
                     or self.decoder.at_capacity(slot)
@@ -689,6 +851,22 @@ class SlotScheduler:
                 leaks.append(
                     f"slot_table_consistent: stream {slots[slot]} claims "
                     f"slot {slot} the decoder thinks is free"
+                )
+        for rec in getattr(self.decoder, "last_verify", None) or []:
+            drafts = rec.get("drafts", [])
+            samples = rec.get("samples", [])
+            a = 0
+            while a < len(drafts) and drafts[a] == samples[a]:
+                a += 1
+            if rec.get("accepted") != a or (
+                rec.get("tokens") != samples[:a + 1]
+            ):
+                leaks.append(
+                    "spec_prefix_accept: slot "
+                    f"{rec.get('slot')} committed {rec.get('tokens')} "
+                    f"(claimed accepted={rec.get('accepted')}) but the "
+                    f"longest matched prefix of drafts {drafts} vs "
+                    f"samples {samples} is {a}"
                 )
         kv_audit = getattr(
             getattr(self.decoder, "kv", None), "audit", None

@@ -73,6 +73,10 @@ VERIFIED_INVARIANTS = (
     ("kv.no_shared_page_writes",
      "a refcount>1 page is immutable — write_tokens raises on any "
      "write attempt (checked inline, copy-on-write discipline)"),
+    ("kv.rollback_private_only",
+     "a speculative rollback (truncate_slot) only ever frees PRIVATE "
+     "lookahead pages — it raises on any prefix-cache-held or shared "
+     "page (checked inline on every truncation)"),
 )
 
 
@@ -148,6 +152,7 @@ class PagedKVCache:
         self.cow_copies_total = 0
         self.pages_reclaimed_total = 0
         self.alloc_failures_total = 0
+        self.rollback_pages_total = 0
 
     # ---- pool accounting ----
 
@@ -210,6 +215,39 @@ class PagedKVCache:
             self._decref(int(self.page_table[slot, logical]))
         self.page_table[slot, :] = 0
         self.alloc_count[slot] = 0
+
+    def truncate_slot(self, slot: int, n_tokens: int) -> int:
+        """Roll a slot's mapping back so it holds exactly the pages
+        covering its first ``n_tokens`` positions; trailing logical
+        pages return to the free list.  This is the speculative-decode
+        rollback: lookahead pages mapped for rejected draft positions
+        are released, everything covering committed tokens stays.
+
+        Safety (kv.rollback_private_only, asserted inline): a truncated
+        page is always a PRIVATE page — the new position count is at
+        least ``prompt_len + 1``, so ``pages_needed(n_tokens)`` strictly
+        exceeds the count of registered/shared full prompt pages and the
+        truncation range can never reach a prefix-cache hold or a
+        refcount>1 mapping.  Hitting one anyway is a refcounting bug,
+        never a condition to paper over, so it raises."""
+        keep = self.pages_needed(n_tokens)
+        held = {e.page_id for e in self._entries.values()}
+        released = 0
+        for logical in range(int(self.alloc_count[slot]) - 1, keep - 1, -1):
+            pid = int(self.page_table[slot, logical])
+            if pid in held or int(self.refcount[pid]) != 1:
+                raise AssertionError(
+                    f"rollback would free non-private page {pid} (slot "
+                    f"{slot} logical {logical}, refcount "
+                    f"{int(self.refcount[pid])}) — speculative lookahead "
+                    "pages must be private (kv.rollback_private_only)"
+                )
+            self._decref(pid)
+            self.page_table[slot, logical] = 0
+            self.alloc_count[slot] = logical
+            released += 1
+        self.rollback_pages_total += released
+        return released
 
     def _decref(self, pid: int) -> None:
         if pid == 0:
@@ -417,4 +455,5 @@ class PagedKVCache:
             "cow_copies_total": self.cow_copies_total,
             "pages_reclaimed_total": self.pages_reclaimed_total,
             "alloc_failures_total": self.alloc_failures_total,
+            "rollback_pages_total": self.rollback_pages_total,
         }

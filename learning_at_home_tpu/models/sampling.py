@@ -1,0 +1,123 @@
+"""Counter-based sampling RNG for the swarm decoders (ISSUE 17).
+
+The serving determinism contracts (preemption-recompute token identity,
+coalescing bitwise parity, chunk-size invariance — PR 13) held because
+decoding was greedy: argmax is a pure function of the logits, so any
+replay of the same positions reproduces the same tokens.  Temperature
+sampling with a *stateful* RNG would break every one of those contracts
+— a preempted stream replays its prefix, consuming RNG draws a
+non-preempted run never made.
+
+This module makes sampled decoding deterministic BY CONSTRUCTION
+instead: the random draw for the token at absolute sequence index ``i``
+of a stream is keyed on ``(stream_seed, i)`` via the counter-based
+threefry generator (``jax.random.fold_in``).  No draw depends on *when*
+or *in which batch* a position is decoded — recompute-after-preemption,
+coalesced vs solo execution and any prefill chunking all visit the same
+``(seed, position)`` pairs and therefore sample the same tokens.  The
+same property is what makes exact self-speculative decoding possible:
+the verifier recomputes the draw a non-speculative pass would have made
+at each position and accepts drafts only where they match
+(models/swarm_decoder.py :meth:`verify_step`).
+
+``temperature == 0`` short-circuits to argmax so greedy streams stay
+bitwise identical to the pre-sampling decoder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MAX_SEED = 2 ** 63 - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-stream sampling configuration, validated at construction so
+    the gateway front door can surface hostile values as well-formed
+    error frames (ValueError) before the decode thread sees them."""
+
+    seed: int = 0
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+
+    def __post_init__(self):
+        if not (0 <= int(self.seed) <= _MAX_SEED):
+            raise ValueError(
+                f"seed must be in [0, 2**63), got {self.seed!r}"
+            )
+        t = float(self.temperature)
+        if not math.isfinite(t) or t < 0.0:
+            raise ValueError(
+                f"temperature must be a finite number >= 0, got "
+                f"{self.temperature!r}"
+            )
+        p = float(self.top_p)
+        if not math.isfinite(p) or not 0.0 < p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p!r}"
+            )
+        if int(self.top_k) < 0:
+            raise ValueError(
+                f"top_k must be >= 0 (0 disables), got {self.top_k!r}"
+            )
+
+    @property
+    def greedy(self) -> bool:
+        return float(self.temperature) == 0.0
+
+    def to_meta(self) -> dict:
+        """The wire representation (gen_submit fields)."""
+        return {
+            "seed": int(self.seed),
+            "temperature": float(self.temperature),
+            "top_p": float(self.top_p),
+            "top_k": int(self.top_k),
+        }
+
+
+def sample_token(
+    logits, params: Optional[SamplingParams], position: int
+) -> int:
+    """Draw the token at absolute sequence index ``position`` from one
+    row of logits.
+
+    ``params is None`` or ``temperature == 0`` is argmax — bitwise the
+    pre-sampling greedy decoder.  Otherwise: scale by temperature, apply
+    the top-k then top-p masks, and draw with
+    ``jax.random.categorical`` under the counter-based key
+    ``fold_in(PRNGKey(seed), position)``.  The draw depends only on
+    ``(logits, seed, position)`` — never on batch composition or call
+    order — which is the whole determinism contract.
+    """
+    if params is None or params.greedy:
+        return int(np.asarray(jnp.argmax(jnp.asarray(logits).reshape(-1))))
+    l = jnp.asarray(logits, jnp.float32).reshape(-1)
+    l = l / float(params.temperature)
+    vocab = int(l.shape[0])
+    k = int(params.top_k)
+    if 0 < k < vocab:
+        # keep everything >= the k-th largest logit (ties kept, so the
+        # mask is order-independent and deterministic)
+        thresh = jax.lax.top_k(l, k)[0][-1]
+        l = jnp.where(l >= thresh, l, -jnp.inf)
+    if float(params.top_p) < 1.0:
+        # nucleus: stable-sort descending, keep tokens whose PRECEDING
+        # cumulative mass is < top_p (the first token always survives)
+        order = jnp.argsort(-l)
+        probs = jax.nn.softmax(l[order])
+        cum = jnp.cumsum(probs)
+        keep_sorted = (cum - probs) < float(params.top_p)
+        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+        l = jnp.where(keep, l, -jnp.inf)
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(int(params.seed)), int(position)
+    )
+    return int(np.asarray(jax.random.categorical(key, l)))

@@ -50,8 +50,23 @@ gateway's ``lah-gw-decode`` thread owns it (and its page pool)
 exclusively (docs/CONCURRENCY.md invariant 12); tests and generate_lm
 drive it from one thread.
 
-Greedy decoding only (temperature 0): serving determinism is what the
-coalescing bitwise tests, preemption-and-recompute, and the A/B gate on.
+Decoding is deterministic for GREEDY and SAMPLED streams alike: the
+token at absolute sequence index ``i`` is drawn under the counter-based
+key ``(stream_seed, i)`` (models/sampling.py), so recompute-after-
+preemption, coalescing and any prefill chunking reproduce identical
+tokens by construction — the property the bitwise/parity tests and the
+A/B gate on.  ``temperature 0`` (the default) short-circuits to argmax
+and stays bitwise identical to the original greedy decoder.
+
+That same determinism makes EXACT self-speculative decoding possible:
+:meth:`verify_step` takes drafted continuations for many streams,
+writes all drafted positions, runs ONE multi-row trunk pass (one
+coalesced expert fan-out per layer instead of one per token), re-draws
+the token every drafted position would have produced, accepts the
+longest matching prefix plus the bonus sample, and rolls the KV pages
+back past the first rejection (:meth:`PagedKVCache.truncate_slot`) —
+output is token-identical to non-speculative decoding, only the number
+of expert round-trips changes.
 """
 
 from __future__ import annotations
@@ -63,6 +78,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from learning_at_home_tpu.models.kv_pages import PagedKVCache, PagePressure
+from learning_at_home_tpu.models.sampling import SamplingParams, sample_token
 from learning_at_home_tpu.models.trunk import (
     attention_core,
     layer_norm,
@@ -162,10 +178,17 @@ class SwarmKVDecoder:
         self.prefilling = np.zeros(self.max_slots, bool)
         self._prefill_prompt: list = [None] * self.max_slots
         self.stream_ids: list = [None] * self.max_slots
+        # per-slot SamplingParams (None = greedy, the argmax fast path)
+        self.sampling: list = [None] * self.max_slots
         self._moe_dispatch = moe_dispatch or default_moe_dispatch
         self.prefills_total = 0
         self.prefill_chunks_total = 0
         self.decode_steps_total = 0
+        self.verify_rounds_total = 0
+        # most recent verify_step outcome, one record per slot — the
+        # scheduler audit recomputes longest-prefix acceptance from it
+        # (scheduler.spec_prefix_accept)
+        self.last_verify: list = []
 
     # ---- slot bookkeeping ----
 
@@ -217,6 +240,7 @@ class SwarmKVDecoder:
         self.prefilling[slot] = False
         self._prefill_prompt[slot] = None
         self.stream_ids[slot] = None
+        self.sampling[slot] = None
         self.pos[slot] = 0
         if self.kv is not None:
             self.kv.release_slot(slot)
@@ -263,15 +287,19 @@ class SwarmKVDecoder:
             )
         return prompt
 
-    def prefill_into_slot(self, slot: int, prompt_ids, stream_id=None) -> int:
+    def prefill_into_slot(self, slot: int, prompt_ids, stream_id=None,
+                          sampling: Optional[SamplingParams] = None) -> int:
         """Full forward over one prompt; K/V written into ``slot``;
-        returns the first greedy token.  The trunk math is exactly
+        returns the first token (argmax, or the counter-keyed draw when
+        ``sampling`` has temperature > 0).  The trunk math is exactly
         ``SwarmDMoETransformerLM.apply`` (trunk.py helpers), so a decoder
         parity test against a re-forward holds to numerical noise.
         Paged layout: one unbounded chunk through the chunked-prefill
         path (and the prefix cache still applies)."""
         if self.kv is not None:
-            self.begin_prefill(slot, prompt_ids, stream_id=stream_id)
+            self.begin_prefill(
+                slot, prompt_ids, stream_id=stream_id, sampling=sampling
+            )
             tok = None
             while tok is None:
                 _consumed, tok = self.prefill_step(slot, self.seq_len)
@@ -294,15 +322,19 @@ class SwarmKVDecoder:
             x = x + jnp.asarray(y).reshape(1, p, cfg.d_model).astype(x.dtype)
         x_last = layer_norm(params["ln_f"], x[:, -1])
         logits = x_last @ params["embed"].T
-        tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        # the first generated token sits at absolute index p — that is
+        # its counter-RNG key position (greedy: plain argmax)
+        tok = sample_token(logits[0], sampling, p)
         self.pos[slot] = p
         self.last_tok[slot] = tok
         self.live[slot] = True
         self.stream_ids[slot] = stream_id
+        self.sampling[slot] = sampling
         self.prefills_total += 1
         return tok
 
-    def begin_prefill(self, slot: int, prompt_ids, stream_id=None) -> int:
+    def begin_prefill(self, slot: int, prompt_ids, stream_id=None,
+                      sampling: Optional[SamplingParams] = None) -> int:
         """Claim ``slot`` for a prompt under the paged layout and serve
         whatever the prefix cache already holds: fully matching pages
         are mapped read-only into the slot's page table, a partial match
@@ -338,6 +370,7 @@ class SwarmKVDecoder:
         self._prefill_prompt[slot] = prompt_list
         self.pos[slot] = matched
         self.stream_ids[slot] = stream_id
+        self.sampling[slot] = sampling
         return matched
 
     def prefill_step(self, slot: int, max_tokens: int):
@@ -394,7 +427,9 @@ class SwarmKVDecoder:
             return c, None
         x_last = layer_norm(params["ln_f"], x[:, -1])
         logits = x_last @ params["embed"].T
-        tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        # key position p: the token produced by a p-token prompt sits at
+        # absolute index p regardless of how the prefill was chunked
+        tok = sample_token(logits[0], self.sampling[slot], p)
         self.kv.register_prefix(slot, prompt)
         self.last_tok[slot] = tok
         self.live[slot] = True
@@ -497,31 +532,204 @@ class SwarmKVDecoder:
             x = x + moe_out[:, None, :]
         x = layer_norm(params["ln_f"], x)
         logits = x[:, 0] @ params["embed"].T
-        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        nxt = np.array(jnp.argmax(logits, axis=-1), np.int32)  # writable
+        # sampled rows override their argmax entry per-row; greedy rows
+        # keep the vectorized argmax value bitwise untouched.  A slot at
+        # position ``pos`` decodes the token at absolute index pos+1 —
+        # its counter-RNG key position.
+        for s in live_rows:
+            s = int(s)
+            sp = self.sampling[s]
+            if sp is not None and not sp.greedy:
+                nxt[s] = sample_token(logits[s], sp, int(self.pos[s]) + 1)
         self.last_tok[self.live] = nxt[self.live]
         self.pos[self.live] += 1
         self.decode_steps_total += 1
         return nxt
 
+    # ---- speculative decode: k drafted tokens per swarm round-trip ----
+
+    def ensure_lookahead_pages(self, slot: int, k: int) -> int:
+        """Map physical pages covering positions ``pos .. pos+k`` of a
+        live slot (the rows a k-draft :meth:`verify_step` writes) and
+        return the largest ``k' <= k`` actually covered — page pressure
+        clamps the proposal instead of failing the round.  Extra pages
+        kept for a clamped/rejected draft are returned to the pool by
+        the rollback inside :meth:`verify_step`.  Under the dense layout
+        every position is preallocated, so ``k`` comes straight back.
+        The caller must already have secured the page for position
+        ``pos`` itself (:meth:`ensure_decode_pages`)."""
+        if self.kv is None:
+            return int(k)
+        pos = int(self.pos[slot])
+        top = min(pos + int(k), self.seq_len - 1)
+        want = top // self.kv.page_len  # logical page of the last row
+        while int(self.kv.alloc_count[slot]) <= want:
+            try:
+                self.kv.alloc_slot_page(slot)
+            except PagePressure:
+                break
+        covered = int(self.kv.alloc_count[slot]) * self.kv.page_len - 1
+        return max(0, min(int(k), covered - pos))
+
+    def verify_step(self, proposals: dict) -> dict:
+        """Advance every slot in ``proposals`` by 1..k+1 tokens in ONE
+        trunk pass — the speculative replacement for :meth:`decode_step`.
+
+        ``proposals`` maps slot -> drafted token list (possibly empty —
+        an empty proposal is exactly a plain decode row).  For a slot at
+        position ``pos`` with last token ``t`` and drafts ``d_0..d_{k-1}``
+        the pass runs k+1 rows with inputs ``[t, d_0, .., d_{k-1}]`` at
+        positions ``pos .. pos+k`` (K/V written before the gather, so
+        within-pass causality holds exactly as in chunked prefill).  Row
+        ``j`` yields the sample ``s_j`` the NON-speculative decoder
+        would have produced at absolute index ``pos+j+1`` given the
+        drafted context; acceptance is the longest prefix with
+        ``d_j == s_j``, and the bonus sample past it is always valid
+        because its row saw only accepted context — so the slot commits
+        ``s_0..s_a`` (a = accepted count) and the output is
+        token-identical to decoding one-by-one.  Rejected lookahead
+        pages are rolled back via :meth:`PagedKVCache.truncate_slot`.
+
+        All rows are live, so the MoE hook sees one flattened row batch
+        per layer — k tokens per stream cost ONE coalesced expert
+        fan-out per layer instead of k.
+
+        Returns ``{slot: {"tokens": [..], "accepted": a, "proposed": k}}``.
+        """
+        if not proposals:
+            return {}
+        slots = sorted(int(s) for s in proposals)
+        row_slot: list[int] = []
+        row_tok: list[int] = []
+        row_pos: list[int] = []
+        for s in slots:
+            if not self.live[s]:
+                raise ValueError(f"slot {s} is not live")
+            drafts = [int(t) for t in proposals[s]]
+            pos = int(self.pos[s])
+            if pos + len(drafts) > self.seq_len - 1:
+                raise ValueError(
+                    f"slot {s}: {len(drafts)} drafts at position {pos} "
+                    f"exceed the cache ({self.seq_len} positions)"
+                )
+            if self.kv is not None:
+                want = (pos + len(drafts)) // self.kv.page_len
+                if int(self.kv.alloc_count[s]) <= want:
+                    raise ValueError(
+                        f"slot {s} has no KV page for its lookahead — "
+                        "call ensure_lookahead_pages() first"
+                    )
+            for j, tok in enumerate([int(self.last_tok[s])] + drafts):
+                row_slot.append(s)
+                row_tok.append(tok)
+                row_pos.append(pos + j)
+        cfg = self.model.cfg
+        params = self.params
+        r = len(row_tok)
+        row_slot_np = np.asarray(row_slot, np.int32)
+        row_pos_np = np.asarray(row_pos, np.int32)
+        pos_j = jnp.asarray(row_pos_np)
+        if self.kv is not None:
+            pids = self.kv.page_table[
+                row_slot_np, row_pos_np // self.kv.page_len
+            ].astype(np.int32)
+            rows = (row_pos_np % self.kv.page_len).astype(np.int32)
+            pt_rows = jnp.asarray(self.kv.page_table[row_slot_np])
+        else:
+            slot_j = jnp.asarray(row_slot_np)
+        x = (
+            params["embed"][jnp.asarray(np.asarray(row_tok, np.int32))]
+            + params["pos"][pos_j]
+        )
+        x = x[:, None, :]  # [R, 1, d]
+        row_streams = [self.stream_ids[s] for s in row_slot]
+        for i, lp in enumerate(params["layers"]):
+            h = layer_norm(lp["ln1"], x)
+            q, k, v = qkv_projections(lp, h, cfg.n_heads)
+            if self.kv is not None:
+                self.kv.write_tokens(i, pids, rows, k[:, 0], v[:, 0])
+                x = x + paged_one_query_attention(
+                    lp, q, self.kv.k_pools[i], self.kv.v_pools[i],
+                    pt_rows, pos_j[:, None, None, None],
+                )
+            else:
+                self.k_caches[i] = (
+                    self.k_caches[i].at[slot_j, pos_j].set(k[:, 0])
+                )
+                self.v_caches[i] = (
+                    self.v_caches[i].at[slot_j, pos_j].set(v[:, 0])
+                )
+                x = x + one_query_attention(
+                    lp, q, self.k_caches[i][slot_j],
+                    self.v_caches[i][slot_j],
+                    pos_j[:, None, None, None],
+                )
+            moe_in = layer_norm(lp["ln2"], x).reshape(r, cfg.d_model)
+            y_rows = self._moe_dispatch(
+                i, self.model.moes[i], lp["gate"], moe_in, row_streams
+            )
+            x = x + jnp.asarray(y_rows).reshape(
+                r, 1, cfg.d_model
+            ).astype(x.dtype)
+        x = layer_norm(params["ln_f"], x)
+        logits = np.asarray(x[:, 0] @ params["embed"].T)
+        out: dict = {}
+        self.last_verify = []
+        row = 0
+        for s in slots:
+            drafts = [int(t) for t in proposals[s]]
+            pos = int(self.pos[s])
+            sp = self.sampling[s]
+            samples = [
+                sample_token(logits[row + j], sp, pos + j + 1)
+                for j in range(len(drafts) + 1)
+            ]
+            row += len(drafts) + 1
+            a = 0
+            while a < len(drafts) and drafts[a] == samples[a]:
+                a += 1
+            tokens = samples[:a + 1]  # accepted drafts + the bonus draw
+            self.pos[s] = pos + a + 1
+            self.last_tok[s] = tokens[-1]
+            if self.kv is not None:
+                self.kv.truncate_slot(s, int(self.pos[s]))
+            out[s] = {
+                "tokens": tokens, "accepted": a, "proposed": len(drafts)
+            }
+            self.last_verify.append({
+                "slot": s, "stream_id": self.stream_ids[s],
+                "drafts": drafts, "samples": samples,
+                "accepted": a, "tokens": list(tokens),
+            })
+        self.verify_rounds_total += 1
+        return out
+
     # ---- convenience: closed-loop batch generation ----
 
     def generate(
-        self, prompts: Sequence[Sequence[int]], max_new_tokens: int
+        self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
+        sampling: Optional[Sequence] = None,
     ) -> list[list[int]]:
         """Decode a fixed batch of prompts to completion (no mid-flight
         joins) — the ``generate_lm.py --swarm`` path and the parity
         tests.  Requires an empty decoder with ``len(prompts) <=
-        max_slots``."""
+        max_slots``.  ``sampling`` is an optional per-prompt list of
+        :class:`SamplingParams` (None entries = greedy)."""
         if len(prompts) > len(self.free_slots()):
             raise ValueError(
                 f"{len(prompts)} prompts need {len(prompts)} free slots, "
                 f"have {len(self.free_slots())}"
             )
+        if sampling is None:
+            sampling = [None] * len(prompts)
         slots = []
         outs: list[list[int]] = []
         for sid, prompt in enumerate(prompts):
             slot = self.free_slots()[0]
-            tok = self.prefill_into_slot(slot, prompt, stream_id=sid)
+            tok = self.prefill_into_slot(
+                slot, prompt, stream_id=sid, sampling=sampling[sid]
+            )
             slots.append(slot)
             outs.append([tok])
         for _ in range(max_new_tokens - 1):

@@ -123,6 +123,53 @@ def test_solve_caps_distinct_moved_experts():
     assert 0 < len({m["uid"] for m in plan["moves"]}) <= 3
 
 
+def swap_locked_snapshot():
+    """Two co-activation clusters split across two FULL nodes (cap ==
+    occupancy): no single-expert move is admissible, only a pair swap
+    can consolidate the clusters."""
+    return {
+        "experts": {
+            "a.0": NODE_A, "a.1": NODE_B,
+            "b.0": NODE_A, "b.1": NODE_B,
+        },
+        "coact": {"a.0|a.1": 500, "b.0|b.1": 500},
+        "links": {NODE_A: {NODE_B: [0.04, 5.0e7]}},
+        "capacity": {NODE_A: 2, NODE_B: 2},
+        "bytes_per_dispatch": 1.5e6,
+    }
+
+
+def test_swap_untangles_capacity_locked_nodes():
+    snap = swap_locked_snapshot()
+    plan = solve(snap, seed=0)
+    assert len(plan["moves"]) == 2, plan
+    assert plan["cost_after"] < plan["cost_before"]
+    final = dict(snap["experts"])
+    occupancy = {NODE_A: 0, NODE_B: 0}
+    for m in plan["moves"]:
+        final[m["uid"]] = m["to"]
+    for node in final.values():
+        occupancy[node] += 1
+    # occupancy unchanged (a swap is capacity-neutral), clusters joined
+    assert occupancy == {NODE_A: 2, NODE_B: 2}
+    assert final["a.0"] == final["a.1"]
+    assert final["b.0"] == final["b.1"]
+
+
+def test_swap_plans_byte_deterministic_per_seed():
+    for seed in (0, 7, 1234):
+        a = plan_to_json(solve(swap_locked_snapshot(), seed=seed))
+        b = plan_to_json(solve(swap_locked_snapshot(), seed=seed))
+        assert a == b
+
+
+def test_swap_respects_max_moves_budget():
+    # a swap moves TWO distinct experts; with budget 1 it must not fire
+    plan = solve(swap_locked_snapshot(), seed=0, max_moves=1)
+    assert plan["moves"] == []
+    assert plan["cost_after"] == plan["cost_before"]
+
+
 def test_solve_tolerates_garbage_snapshots():
     for snap in (
         None, [], {}, {"experts": "nope"},

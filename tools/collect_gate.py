@@ -246,13 +246,28 @@ _PLACEMENT_FIXTURE = {
     "bytes_per_dispatch": 1.5e6,
 }
 
+# capacity-locked interleave: two co-activation clusters split across
+# two FULL nodes (cap == occupancy), so no single-expert move is ever
+# admissible — only the pair-swap neighborhood (ISSUE 17) can untangle
+# it.  Pins the swap path into the same byte-determinism contract.
+_PLACEMENT_SWAP_FIXTURE = {
+    "experts": {
+        "a.0": "10.0.0.1:31330", "a.1": "10.0.0.2:31330",
+        "b.0": "10.0.0.1:31330", "b.1": "10.0.0.2:31330",
+    },
+    "coact": {"a.0|a.1": 500, "b.0|b.1": 500},
+    "links": {
+        "10.0.0.1:31330": {"10.0.0.2:31330": [0.04, 5.0e7]},
+    },
+    "capacity": {"10.0.0.1:31330": 2, "10.0.0.2:31330": 2},
+    "bytes_per_dispatch": 1.5e6,
+}
 
-def placement_stage() -> int:
-    """Stage 0.8: placement-solver determinism smoke (ISSUE 16).  Runs
-    ``lah_rebalance --plan`` twice over an embedded skewed fixture in
-    subprocesses and fails (rc=8) unless both plans are byte-identical,
-    non-empty, and strictly cost-improving — the properties the live
-    SLO-gated driver depends on."""
+
+def _placement_plan_twice(fixture: dict, label: str):
+    """Run ``lah_rebalance --plan`` twice over ``fixture``; returns the
+    parsed plan, or None after printing the failure (the byte-diff is
+    the determinism contract the live driver depends on)."""
     import tempfile
 
     env = dict(os.environ)
@@ -260,7 +275,7 @@ def placement_stage() -> int:
     with tempfile.NamedTemporaryFile(
         "w", suffix=".json", delete=False
     ) as fh:
-        json.dump(_PLACEMENT_FIXTURE, fh)
+        json.dump(fixture, fh)
         snap_path = fh.name
     try:
         outs = []
@@ -275,44 +290,62 @@ def placement_stage() -> int:
                         "COLLECT_GATE_PLACEMENT_TIMEOUT_S", "60")),
                 )
             except subprocess.TimeoutExpired:
-                print("collect_gate: lah_rebalance --plan timed out",
-                      file=sys.stderr)
-                return 8
+                print(f"collect_gate: lah_rebalance --plan ({label}) "
+                      "timed out", file=sys.stderr)
+                return None
             if r.returncode != 0:
-                print("collect_gate: FAIL — lah_rebalance --plan:",
-                      file=sys.stderr)
+                print(f"collect_gate: FAIL — lah_rebalance --plan "
+                      f"({label}):", file=sys.stderr)
                 print(r.stdout[-2000:], file=sys.stderr)
                 print(r.stderr[-1000:], file=sys.stderr)
-                return 8
+                return None
             outs.append(r.stdout)
     finally:
         os.unlink(snap_path)
     if outs[0] != outs[1]:
-        print("collect_gate: FAIL — placement plans for one (snapshot, "
-              "seed) differ between runs:", file=sys.stderr)
+        print(f"collect_gate: FAIL — placement plans ({label}) for one "
+              "(snapshot, seed) differ between runs:", file=sys.stderr)
         print(outs[0], file=sys.stderr)
         print(outs[1], file=sys.stderr)
-        return 8
+        return None
     try:
-        plan = json.loads(outs[0])
+        return json.loads(outs[0])
     except ValueError:
-        print("collect_gate: FAIL — --plan printed non-JSON:",
+        print(f"collect_gate: FAIL — --plan ({label}) printed non-JSON:",
               file=sys.stderr)
         print(outs[0][-500:], file=sys.stderr)
-        return 8
-    if not plan.get("moves"):
-        print("collect_gate: FAIL — solver found no moves on the skewed "
-              "fixture (must consolidate the split clusters)",
-              file=sys.stderr)
-        return 8
-    if not plan["cost_after"] < plan["cost_before"]:
-        print("collect_gate: FAIL — plan does not improve cost "
-              f"({plan['cost_before']} -> {plan['cost_after']})",
-              file=sys.stderr)
-        return 8
-    print(f"collect_gate: placement OK — byte-identical plan, "
-          f"{len(plan['moves'])} move(s), cost {plan['cost_before']} -> "
-          f"{plan['cost_after']}")
+        return None
+
+
+def placement_stage() -> int:
+    """Stage 0.8: placement-solver determinism smoke (ISSUE 16/17).
+    Runs ``lah_rebalance --plan`` twice each over an embedded skewed
+    fixture AND a capacity-locked fixture only pair swaps can improve,
+    in subprocesses, and fails (rc=8) unless every plan is
+    byte-identical across runs, non-empty, and strictly cost-improving
+    — the properties the live SLO-gated driver depends on."""
+    for label, fixture, empty_msg in (
+        ("skewed", _PLACEMENT_FIXTURE,
+         "solver found no moves on the skewed fixture (must "
+         "consolidate the split clusters)"),
+        ("capacity-locked swap", _PLACEMENT_SWAP_FIXTURE,
+         "solver found no moves on the capacity-locked fixture (the "
+         "pair-swap neighborhood must untangle full nodes)"),
+    ):
+        plan = _placement_plan_twice(fixture, label)
+        if plan is None:
+            return 8
+        if not plan.get("moves"):
+            print(f"collect_gate: FAIL — {empty_msg}", file=sys.stderr)
+            return 8
+        if not plan["cost_after"] < plan["cost_before"]:
+            print(f"collect_gate: FAIL — plan ({label}) does not "
+                  f"improve cost ({plan['cost_before']} -> "
+                  f"{plan['cost_after']})", file=sys.stderr)
+            return 8
+        print(f"collect_gate: placement OK ({label}) — byte-identical "
+              f"plan, {len(plan['moves'])} move(s), cost "
+              f"{plan['cost_before']} -> {plan['cost_after']}")
     return 0
 
 

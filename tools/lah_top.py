@@ -18,6 +18,9 @@ and renders one aggregated view:
   with each expert's home node, plus the migration ledger — per-server
   completed/failed counts, moves in flight, and the rebalancing
   driver's aborted-by-SLO total when one is heartbeating;
+- a speculation panel (ISSUE 17): per-gateway draft acceptance rate,
+  effective tokens per swarm round-trip and drafter overhead share,
+  for gateways running with ``LAH_GW_SPEC_K > 0``;
 - dead peers: ids seen in an earlier refresh whose record expired, plus
   peers whose record is live but whose endpoint stopped answering.
 
@@ -308,6 +311,44 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
                 f"{int(_num(drv.get('failed')))} failed, "
                 f"{int(_num(drv.get('aborted_slo')))} aborted-by-SLO"
                 + (f", moving {moving}" if isinstance(moving, str) else "")
+            )
+    # speculation panel (ISSUE 17): per-gateway acceptance rate,
+    # effective tokens per swarm round-trip and draft overhead share —
+    # only gateways running with spec_k > 0 appear (a dash-free panel:
+    # spec-off gateways simply have no row)
+    spec_rows = []
+    for row in rows:
+        gw = _section(row, "gateway")
+        k = gw.get("spec_k")
+        if (
+            not isinstance(k, (int, float)) or isinstance(k, bool)
+            or k <= 0
+        ):
+            continue
+        draft = _num(gw.get("spec_draft_seconds_total"))
+        verify = _num(gw.get("spec_verify_seconds_total"))
+        wall = draft + verify
+        spec_rows.append((
+            row["peer_id"], int(k),
+            _num(gw.get("spec_acceptance_rate")),
+            _num(gw.get("spec_effective_k")),
+            int(_num(gw.get("spec_rounds_total"))),
+            draft / wall if wall else 0.0,
+        ))
+    if spec_rows:
+        lines.append("")
+        lines.append(
+            "SPECULATION (per-gateway; EFF-K = tokens per swarm "
+            "round-trip, DRAFT% = drafter share of decode wall time):"
+        )
+        lines.append(
+            f"  {'GATEWAY':<28} {'K':>3} {'ACCEPT':>7} {'EFF-K':>6} "
+            f"{'ROUNDS':>8} {'DRAFT%':>7}"
+        )
+        for peer_id, k, acc, eff, rounds, share in sorted(spec_rows):
+            lines.append(
+                f"  {peer_id:<28.28} {k:>3} {100 * acc:>6.1f}% "
+                f"{eff:>6.2f} {rounds:>8} {100 * share:>6.1f}%"
             )
     # span-level latency only exists on peers running LAH_PROFILE=1
     p99 = {}
