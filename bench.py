@@ -229,11 +229,93 @@ def run_dht_sim_bench(deadline: int = 420, sizes: str = "128,512") -> dict | Non
     return out
 
 
+def run_macro_sim_bench(
+    deadline: int = 240,
+    nodes: int = 200,
+    servers: int = 48,
+    gateways: int = 4,
+    experts: int = 64,
+    slots: int = 32,
+    trace: str = "poisson:60:6,burst:480:3",
+    churn: str = "4:kill:0.15",
+    min_completed: int = 300,
+    shed_min: float = 0.01,
+    shed_max: float = 0.55,
+    ttft_p99_max_ms: float = 45000.0,
+    hit_rate_floor: float = 0.75,
+) -> dict | None:
+    """Full-system macro-sim (ISSUE 18) in a scrubbed CPU subprocess:
+    virtual-clock swarm of servers + gateways + DHT nodes serving a
+    bursty trace with mid-run churn, with the accounting / shed /
+    TTFT-tail / lookup-hit floors asserted by the harness itself
+    (``--check``).  Defaults keep the full-bench wall bounded; the
+    2k-node / 27k-stream run lives behind the standalone --macro-sim
+    mode."""
+    from learning_at_home_tpu.utils.subproc import clean_jax_subprocess_env
+
+    env = clean_jax_subprocess_env(repo_root=REPO)
+    env.pop("XLA_FLAGS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "learning_at_home_tpu.sim.runner",
+             "--nodes", str(nodes), "--servers", str(servers),
+             "--gateways", str(gateways), "--experts", str(experts),
+             "--slots", str(slots), "--trace", trace, "--churn", churn,
+             "--check", "--min-completed", str(min_completed),
+             "--shed-min", str(shed_min), "--shed-max", str(shed_max),
+             "--ttft-p99-max-ms", str(ttft_p99_max_ms),
+             "--hit-rate-floor", str(hit_rate_floor)],
+            capture_output=True, text=True, timeout=deadline, cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: macro sim timed out", file=sys.stderr)
+        return None
+    if r.returncode != 0 or "MACRO_SIM_OK" not in r.stdout:
+        print(f"bench: macro sim rc={r.returncode}\n"
+              f"stderr: {_tail(r.stderr)}", file=sys.stderr)
+        return None
+    report = None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                report = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if not report or "traffic" not in report:
+        return None
+    tr, sw, dht = report["traffic"], report["swarm"], report["dht"]
+    burst_ttft = [
+        seg["ttft_p99_ms"] for name, seg in tr["segments"].items()
+        if "burst" in name
+    ]
+    out = {
+        "macro_sim_nodes": report["config"]["nodes"],
+        "macro_sim_arrivals": tr["arrivals"],
+        "macro_sim_completed": tr["completed"],
+        "macro_sim_shed_fraction": tr["shed_fraction"],
+        "macro_sim_fleet_tok_s": tr["fleet_tok_s"],
+        "macro_sim_ttft_p99_ms": tr["ttft_p99_ms"],
+        "macro_sim_itl_p99_ms": tr["itl_p99_ms"],
+        "macro_sim_burst_ttft_p99_ms": max(burst_ttft) if burst_ttft else None,
+        "macro_sim_hit_rate": dht["hit_rate"],
+        "macro_sim_join_mean_ms": sw["join_mean_ms"],
+        "macro_sim_killed": sw["killed"],
+        "macro_sim_virtual_duration_s": report["virtual_duration_s"],
+    }
+    plc = report.get("placement") or {}
+    if plc.get("cost_initial") is not None:
+        out["macro_sim_placement_cost_initial"] = plc["cost_initial"]
+        out["macro_sim_placement_cost_final"] = plc["cost_final"]
+    return out
+
+
 # The previous round's final commit: the CPU-fallback artifact compares
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "a77e7cb"
+PREV_ROUND_REV = "5edc570"
 
 
 def check_orphan_servers() -> dict | None:
@@ -441,6 +523,14 @@ def main() -> int:
         dht = run_dht_sim_bench()
         if dht:
             result.update(dht)
+        # full-system macro-sim series (ISSUE 18): real scheduler /
+        # admission / routing / placement code on a virtual clock under
+        # a bursty trace with churn; the 200-node config keeps the
+        # full-bench wall bounded — the 2k-node / 27k-stream run lives
+        # behind the standalone --macro-sim mode
+        mac = run_macro_sim_bench()
+        if mac:
+            result.update(mac)
     # paper-reference series (learning@home, Table 1): the decode-side
     # quality gap of a 4096-expert DMoE vs its dense baseline grows with
     # experts-per-sample — 0.336 nats at k=16, 0.568 at k=32.  Recorded
@@ -2492,6 +2582,22 @@ if __name__ == "__main__":
         print(json.dumps(_dht if _dht else {"error": "dht sim failed"}),
               flush=True)
         sys.exit(0 if _dht else 1)
+    if "--macro-sim" in sys.argv:
+        # standalone full-system macro-sim (ISSUE 18): the 2048-node
+        # swarm serving ~27k streams across poisson/burst/diurnal
+        # segments with kill-and-join churn, byte-deterministic on one
+        # virtual clock, with the --check floors asserted
+        _mac = run_macro_sim_bench(
+            deadline=900, nodes=2048, servers=256, gateways=16,
+            experts=256, slots=64,
+            trace="poisson:180:40,burst:900:10,diurnal:220:50:0.5:25",
+            churn="35:kill:0.1,60:join:26",
+            min_completed=15000, shed_min=0.0005, shed_max=0.6,
+            ttft_p99_max_ms=60000.0, hit_rate_floor=0.8,
+        )
+        print(json.dumps(_mac if _mac else {"error": "macro sim failed"}),
+              flush=True)
+        sys.exit(0 if _mac else 1)
     if "--gateway" in sys.argv:
         # standalone serving-gateway A/B (ISSUE 12): continuous batching
         # vs sequential + the admission-control arms, in the same

@@ -43,13 +43,16 @@ from typing import Any, Optional
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from learning_at_home_tpu.dht.node import DHTNode
-from learning_at_home_tpu.dht.protocol import (
-    ADAPTIVE_TIMEOUT_FLOOR,
-    ADAPTIVE_TIMEOUT_MULT,
-    DHTProtocol,
-    PLAIN_SUBKEY,
-)
+from learning_at_home_tpu.dht.protocol import PLAIN_SUBKEY
 from learning_at_home_tpu.dht.routing import Endpoint
+# ISSUE 18: the simulated fabric (SimNetwork / SimDHTProtocol /
+# spawn_node) and the clock/churn machinery moved into the sim package
+# — ONE implementation shared with the whole-system macro-sim
+# (learning_at_home_tpu/sim/).  This experiment keeps its historical
+# CLI, floors and report shape, on wall time by default.
+from learning_at_home_tpu.sim.clock import WallClock
+from learning_at_home_tpu.sim.net import SIM_HOST, SimNetwork, spawn_node
+from learning_at_home_tpu.sim.trace import churn_rounds as churn_schedule
 from learning_at_home_tpu.utils.telemetry import (
     load_key,
     replicas_wanted_key,
@@ -57,111 +60,8 @@ from learning_at_home_tpu.utils.telemetry import (
 )
 from learning_at_home_tpu.utils.timed_storage import get_dht_time
 
-SIM_HOST = "127.0.0.1"
-
-
-class SimNetwork:
-    """Endpoint → protocol registry plus the delivery fabric.
-
-    Delivery to a registered peer invokes its REAL ``_serve`` directly
-    (requests/replies are plain msgpack-able dicts on both sides of the
-    real wire, so passing them by reference preserves semantics).
-    Delivery to an unregistered endpoint — a killed node — costs the
-    caller its own adaptive timeout, exactly like a dead socket."""
-
-    def __init__(self, latency: float = 0.0):
-        self.latency = latency
-        self._by_port: dict[int, DHTProtocol] = {}
-        self._next_port = 1
-        self.rpcs: dict[str, int] = {}
-
-    def register(self, proto: DHTProtocol) -> int:
-        port = self._next_port
-        self._next_port += 1
-        self._by_port[port] = proto
-        return port
-
-    def unregister(self, proto: DHTProtocol) -> None:
-        if proto.listen_port is not None:
-            self._by_port.pop(proto.listen_port, None)
-
-    async def deliver(
-        self, src: "SimDHTProtocol", endpoint: Endpoint, msg_type: str,
-        meta: dict,
-    ) -> Optional[dict]:
-        self.rpcs[msg_type] = self.rpcs.get(msg_type, 0) + 1
-        dest = self._by_port.get(int(endpoint[1]))
-        if dest is None:
-            # dead peer: the caller's OWN adaptive budget bounds the wait
-            await asyncio.sleep(src.timeout_for(endpoint))
-            return None
-        if self.latency > 0:
-            await asyncio.sleep(self.latency)
-        return dest._serve(msg_type, meta, SIM_HOST)
-
-
-class SimDHTProtocol(DHTProtocol):
-    """The real protocol with the socket layer replaced.
-
-    Overrides exactly the transport seam (``_transport``) plus
-    listen/shutdown; envelope building, RPC accounting, reply parsing
-    and the adaptive-timeout CONTRACT are the production code.  The RTT
-    EMA normally lives in the connection pool, so the sim keeps its own
-    per-endpoint EMA with the same fold rule (timeouts count)."""
-
-    def __init__(self, network: SimNetwork, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self.network = network
-        self.rtt_ema: dict[Endpoint, float] = {}
-
-    async def listen(self, host: str, port: int) -> int:
-        self.listen_port = self.network.register(self)
-        return self.listen_port
-
-    async def shutdown(self) -> None:
-        self.network.unregister(self)
-        self._pools.close()  # never opened a socket; releases bookkeeping
-
-    def timeout_for(self, endpoint: Endpoint) -> float:
-        ema = self.rtt_ema.get(endpoint)
-        if ema is not None:
-            return min(
-                max(ADAPTIVE_TIMEOUT_MULT * ema, ADAPTIVE_TIMEOUT_FLOOR),
-                self.rpc_timeout,
-            )
-        return self.rpc_timeout
-
-    async def _transport(
-        self, endpoint: Endpoint, msg_type: str, meta: dict
-    ) -> Optional[dict]:
-        t0 = time.monotonic()
-        reply = await self.network.deliver(self, endpoint, msg_type, meta)
-        elapsed = time.monotonic() - t0
-        ema = self.rtt_ema.get(endpoint)
-        # timeouts fold too (the pool's latency-signal rule): a peer that
-        # outgrows its budget raises its own budget next call
-        self.rtt_ema[endpoint] = (
-            elapsed if ema is None else 0.8 * ema + 0.2 * elapsed
-        )
-        if reply is None:
-            raise asyncio.TimeoutError(f"sim peer {endpoint} unreachable")
-        return reply
-
-
-async def spawn_node(
-    network: SimNetwork,
-    initial_peers=(),
-    rpc_timeout: float = 0.8,
-    **node_kwargs,
-) -> DHTNode:
-    node = DHTNode(rpc_timeout=rpc_timeout, **node_kwargs)
-    node.protocol = SimDHTProtocol(
-        network, node.node_id, node.routing_table, node.storage, rpc_timeout
-    )
-    await node.protocol.listen(SIM_HOST, 0)
-    if initial_peers:
-        await node.bootstrap(initial_peers)
-    return node
+__all__ = ["SIM_HOST", "SimNetwork", "spawn_node", "heartbeat_entries",
+           "heartbeat_ab", "run_size", "main"]
 
 
 # ---------------- heartbeat record bundle (mirrors DHT._declare) ----------------
@@ -191,7 +91,7 @@ def heartbeat_entries(
     return entries
 
 
-async def heartbeat_ab(node: DHTNode, make_entries) -> dict:
+async def heartbeat_ab(node: DHTNode, make_entries, clock=WallClock()) -> dict:
     """Store one heartbeat bundle twice — per-key (baseline) then
     coalesced — and report the store-RPC counts from the publisher's
     own ``rpcs_sent`` counter (the same-run A/B the acceptance asks
@@ -206,20 +106,20 @@ async def heartbeat_ab(node: DHTNode, make_entries) -> dict:
     def stores() -> int:
         return node.protocol.rpcs_sent.get("store", 0)
 
-    t0 = time.monotonic()
+    t0 = clock.monotonic()
     base = stores()
     for group in by_key.values():
         acks = await node.store_many(group)
         assert all(acks), "per-key baseline store failed"
     per_key_rpcs = stores() - base
-    per_key_s = time.monotonic() - t0
+    per_key_s = clock.monotonic() - t0
 
-    t0 = time.monotonic()
+    t0 = clock.monotonic()
     base = stores()
     acks = await node.store_many(make_entries())
     assert all(acks), "coalesced store failed"
     coalesced_rpcs = stores() - base
-    coalesced_s = time.monotonic() - t0
+    coalesced_s = clock.monotonic() - t0
     return {
         "keys": len(by_key),
         "records": len(entries),
@@ -245,20 +145,21 @@ async def run_size(
     latency: float,
     record_ttl: float,
     rng: random.Random,
+    clock=WallClock(),
 ) -> dict:
     network = SimNetwork(latency=latency)
     seed = await spawn_node(network, rpc_timeout=rpc_timeout)
     nodes = [seed]
     join_times: list[float] = []
     for _ in range(n - 1):
-        t0 = time.monotonic()
+        t0 = clock.monotonic()
         nodes.append(
             await spawn_node(
                 network, initial_peers=[seed.endpoint],
                 rpc_timeout=rpc_timeout,
             )
         )
-        join_times.append(time.monotonic() - t0)
+        join_times.append(clock.monotonic() - t0)
     join_times.sort()
     join = {
         "total_s": round(sum(join_times), 3),
@@ -280,6 +181,7 @@ async def run_size(
     ab = await heartbeat_ab(
         publisher,
         lambda: heartbeat_entries(prefix, experts, publisher.endpoint, hb_ttl),
+        clock=clock,
     )
 
     # -- churn: kill-and-replace rounds against a heartbeating publisher --
@@ -308,13 +210,18 @@ async def run_size(
     total = 0
     lookup_times: list[float] = []
     killed_total = 0
+    # the kill schedule in the shared trace vocabulary (sim/trace.py):
+    # one kill event per round, paced at the settle interval
+    schedule = churn_schedule(
+        max(1, churn_rounds), churn_fraction, every_s=churn_wait
+    )
     try:
-        for _ in range(max(1, churn_rounds)):
+        for event in schedule:
             killable = [
                 nd for nd in nodes[2:]
                 if nd.protocol.listen_port in network._by_port
             ]
-            n_kill = int(len(killable) * churn_fraction)
+            n_kill = int(len(killable) * event.fraction)
             victims = rng.sample(killable, n_kill) if n_kill else []
             for v in victims:
                 await v.shutdown()
@@ -345,9 +252,9 @@ async def run_size(
             async def one_lookup() -> bool:
                 q = rng.choice(alive)
                 uid = rng.choice(uids)
-                t0 = time.monotonic()
+                t0 = clock.monotonic()
                 rec = await q.get(uid)
-                lookup_times.append(time.monotonic() - t0)
+                lookup_times.append(clock.monotonic() - t0)
                 return want_subkey in rec
 
             n_round = max(1, lookups // max(1, churn_rounds))

@@ -99,6 +99,7 @@ def run_load(
     top_p: float = None,
     top_k: int = None,
     sample_seed: int = None,
+    trace=None,
 ) -> dict:
     """Drive one gateway open-loop and return the JSON-ready report.
 
@@ -106,8 +107,25 @@ def run_load(
     :class:`GatewayClient`; the RPC pool muxes them over shared
     connections).  After the arrival window closes, in-flight streams are
     drained up to ``drain_timeout_s`` so served-token counts are not
-    truncated mid-stream."""
+    truncated mid-stream.
+
+    ``trace`` (a :class:`~learning_at_home_tpu.sim.trace.Trace` or a
+    segment-spec string — the SAME grammar the macro-sim scenarios use)
+    replaces the constant-rate Poisson process with the trace's arrival
+    schedule: ``rate_hz`` and ``duration_s`` are then taken from the
+    trace, so a shape validated in simulation replays 1:1 against a real
+    gateway."""
     from learning_at_home_tpu.gateway import GatewayClient
+
+    if isinstance(trace, str):
+        from learning_at_home_tpu.sim.trace import parse_trace
+        trace = parse_trace(trace)
+    if trace is not None:
+        duration_s = trace.duration_s
+        rate_hz = (
+            sum(s.rate_hz * s.duration_s for s in trace.segments)
+            / max(1e-9, duration_s)
+        )
 
     client = GatewayClient(endpoint)
     rng = np.random.RandomState(seed)
@@ -184,8 +202,18 @@ def run_load(
 
     t0 = time.monotonic()
     deadline = t0 + duration_s
-    next_arrival = t0
-    while next_arrival < deadline:
+    if trace is not None:
+        import random as pyrandom
+
+        # the same seeded thinning stream the macro-sim injector draws,
+        # so sim and real replay the identical arrival schedule
+        _offsets = trace.iter_arrivals(pyrandom.Random(f"{seed}|trace"))
+        next_arrival = next(_offsets, None)
+        next_arrival = None if next_arrival is None else t0 + next_arrival
+    else:
+        _offsets = None
+        next_arrival = t0
+    while next_arrival is not None and next_arrival < deadline:
         delay = next_arrival - time.monotonic()
         if delay > 0:
             time.sleep(delay)
@@ -216,7 +244,11 @@ def run_load(
         threads.append(th)
         report["arrivals"] += 1
         buckets[name]["arrivals"] += 1
-        next_arrival += float(rng.exponential(1.0 / rate_hz))
+        if _offsets is not None:
+            t = next(_offsets, None)
+            next_arrival = None if t is None else t0 + t
+        else:
+            next_arrival += float(rng.exponential(1.0 / rate_hz))
     for th in threads:
         th.join(timeout=drain_timeout_s)
     wall = time.monotonic() - t0
@@ -234,8 +266,11 @@ def run_load(
             }
             for name, rec in buckets.items()
         }
+    if trace is not None:
+        from learning_at_home_tpu.sim.trace import trace_to_json
+        out["trace"] = trace_to_json(trace)
     out.update(
-        rate_hz=rate_hz,
+        rate_hz=round(rate_hz, 3),
         duration_s=duration_s,
         wall_s=round(wall, 3),
         tokens_per_sec=round(out["tokens_served"] / wall, 2) if wall else 0.0,
@@ -259,6 +294,11 @@ def main(argv=None) -> int:
                     help="mean Poisson arrival rate, requests/s")
     ap.add_argument("--duration", type=float, default=10.0,
                     help="arrival window, seconds (drain not included)")
+    ap.add_argument("--trace", type=str, default=None,
+                    help="arrival-trace segment spec (sim/trace.py "
+                         "grammar, e.g. 'poisson:20:10,burst:200:3,"
+                         "diurnal:30:60:0.5:20'); overrides "
+                         "--rate/--duration with the trace's schedule")
     ap.add_argument("--prompt-len", type=int, nargs=2, default=(4, 12),
                     metavar=("MIN", "MAX"))
     ap.add_argument("--prompt-len-dist", type=str, default=None,
@@ -309,6 +349,7 @@ def main(argv=None) -> int:
         top_p=args.top_p,
         top_k=args.top_k,
         sample_seed=args.sample_seed,
+        trace=args.trace,
     )
     print(json.dumps(report))
     return 0

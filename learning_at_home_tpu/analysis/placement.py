@@ -212,11 +212,19 @@ def solve(
     seed: int = 0,
     max_moves: int = DEFAULT_MAX_MOVES,
     max_rounds: int = DEFAULT_MAX_ROUNDS,
+    neighborhoods: tuple = ("move", "swap"),
 ) -> dict:
     """Snapshot in, migration plan out.  Deterministic per (snapshot,
     seed); tolerant of malformed/empty sections (empty plan, never a
     raise).  Capacity: explicit per-node caps from the snapshot, else
-    a balanced default of ceil(n_experts / n_nodes) + 1 slack."""
+    a balanced default of ceil(n_experts / n_nodes) + 1 slack.
+
+    ``neighborhoods`` selects the local-search moves explored per round:
+    ``"move"`` (single-expert relocation) and/or ``"swap"`` (pair
+    exchange).  The default runs both; restricting to ``("move",)``
+    exists for A/B evaluation — the macro-sim's placement stress pins
+    that the swap neighborhood strictly improves clustered topologies
+    where every profitable single move is capacity-blocked."""
     model = _Model(snapshot)
     uids = sorted(model.assign)
     plan = {
@@ -235,8 +243,10 @@ def solve(
     initial = dict(model.assign)
     rng = random.Random(int(seed))
     moved: set = set()
+    do_move = "move" in neighborhoods
+    do_swap = "swap" in neighborhoods
     for _ in range(max_rounds):
-        order = list(uids)
+        order = list(uids) if do_move else []
         rng.shuffle(order)
         improved = False
         for uid in order:
@@ -268,7 +278,7 @@ def solve(
             (uids[i], uids[j])
             for i in range(len(uids))
             for j in range(i + 1, len(uids))
-        ]
+        ] if do_swap else []
         rng.shuffle(pairs)
         for u, v in pairs:
             nu, nv = model.assign[u], model.assign[v]

@@ -642,14 +642,10 @@ _LIVELOCK_PREEMPTIONS = 16
 _DRAIN_ITERATIONS = 64
 
 
-class _VirtualClock:
-    def __init__(self, step: float = 1.0):
-        self.now = 0.0
-        self.step = step
-
-    def __call__(self) -> float:
-        self.now += self.step
-        return self.now
+# The virtual clock the worlds patch over the scheduler/lifecycle
+# _monotonic seams graduated into sim/clock.py (ISSUE 18): every read
+# advances by ``step``, which exercises TTL/pacing branches for free.
+from learning_at_home_tpu.sim.clock import VirtualClock as _VirtualClock
 
 
 class _GatewayWorld:

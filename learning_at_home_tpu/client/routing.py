@@ -26,6 +26,10 @@ from learning_at_home_tpu.utils.connection import Endpoint
 
 logger = logging.getLogger(__name__)
 
+# Clock seam: alive-set and load/link feed TTL stamps read time through
+# here so sim/clock.py can virtualize them (docs/SIMULATION.md).
+_monotonic = time.monotonic
+
 UID_DELIMITER = "."
 
 # A replica set: every endpoint currently hosting one expert uid, in a
@@ -327,7 +331,7 @@ class CachedAliveSet:
         return await self.source.get_alive_experts(self.prefix)
 
     async def get(self, force_refresh: bool = False) -> dict[str, Endpoint]:
-        now = time.monotonic()
+        now = _monotonic()
         stale = self._cached is None or now - self._stamp > self.ttl
         if not (force_refresh or stale):
             return self._cached
@@ -342,7 +346,7 @@ class CachedAliveSet:
                 self._refreshing.cancel()
             self._refreshing = None
             self._cached = await self._fetch(fresh=force_refresh)
-            self._stamp = time.monotonic()
+            self._stamp = _monotonic()
             return self._cached
         # stale-while-revalidate: hand back the stale set NOW; at most
         # one background refresh in flight (loop-confined state — this
@@ -367,7 +371,7 @@ class CachedAliveSet:
                          self.prefix, type(e).__name__, e)
             return
         self._cached = alive
-        self._stamp = time.monotonic()
+        self._stamp = _monotonic()
 
     def peek_fresh(self) -> Optional[dict[str, Endpoint]]:
         """The cached alive set if still within TTL, else None — a pure
@@ -376,7 +380,7 @@ class CachedAliveSet:
         one-per-TTL-window refresh (a bounded control-plane lookup)."""
         if (
             self._cached is not None
-            and time.monotonic() - self._stamp <= self.ttl
+            and _monotonic() - self._stamp <= self.ttl
         ):
             return self._cached
         return None
@@ -536,7 +540,7 @@ class RoutingCostModel:
         one window and counts the failure)."""
         if self._load_getter is None:
             return self._loads
-        now = time.monotonic()
+        now = _monotonic()
         if now - self._loads_stamp > self.load_ttl:
             self._loads_stamp = now  # stamp first: one refresh per window
             try:
@@ -563,7 +567,7 @@ class RoutingCostModel:
         (stamp-first; a failed refresh keeps the stale map one window)."""
         if self._link_getter is None:
             return self._links
-        now = time.monotonic()
+        now = _monotonic()
         if now - self._links_stamp > self.link_ttl:
             self._links_stamp = now
             try:

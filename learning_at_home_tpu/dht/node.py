@@ -30,6 +30,11 @@ from learning_at_home_tpu.utils.timed_storage import DHTExpiration, get_dht_time
 
 logger = logging.getLogger(__name__)
 
+# Clock seam: maintenance pacing, lookup timing and lookup-strike
+# bookkeeping all read time through here so sim/clock.py can virtualize
+# them (docs/SIMULATION.md).
+_monotonic = time.monotonic
+
 _LOOKUP_SECONDS = _metrics.histogram(
     "lah_dht_lookup_seconds", "iterative lookup wall-clock",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
@@ -181,7 +186,7 @@ class DHTNode:
                         heard = self.routing_table.last_heard.get(nid)
                         if (
                             heard is not None
-                            and time.monotonic() - heard <= period
+                            and _monotonic() - heard <= period
                         ):
                             # piggybacked liveness (ISSUE 11): a reply or
                             # inbound request within the last period IS a
@@ -196,11 +201,11 @@ class DHTNode:
                             and await self.protocol.call_ping(endpoint) is None
                         ):
                             self.routing_table.remove_node(nid)
-                    if bucket.peers and time.monotonic() - bucket.last_updated > period:
+                    if bucket.peers and _monotonic() - bucket.last_updated > period:
                         await self.find_nearest_nodes(
                             random_id_in_range(bucket.lower, bucket.upper)
                         )
-                        bucket.last_updated = time.monotonic()
+                        bucket.last_updated = _monotonic()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -231,7 +236,7 @@ class DHTNode:
             self.routing_table.remove_node(nid)
             self._lookup_strikes.pop(nid, None)  # nid may not be in table
         elif entry is None:
-            self._lookup_strikes[nid] = (lookup_id, time.monotonic())
+            self._lookup_strikes[nid] = (lookup_id, _monotonic())
             # strikes can reference peers never admitted to the table
             # (shortlist members learned mid-lookup) — the table hook
             # can't clear those, so bound the dict under churn.  Entries
@@ -250,7 +255,7 @@ class DHTNode:
         self, target: DHTID, find_value: bool
     ) -> tuple[dict[str, tuple[Any, DHTExpiration]], list[tuple[DHTID, Endpoint]]]:
         lookup_id = next(self._lookup_counter)
-        lookup_t0 = time.monotonic()
+        lookup_t0 = _monotonic()
         key_bytes = target.to_bytes()
         # seed with 2k neighbors, not k: a k-sized seed drawn from a
         # sparse table can lie entirely inside one local cluster, and the
@@ -279,7 +284,7 @@ class DHTNode:
             if not candidates:
                 break
             queried.update(candidates)
-            wave_started = time.monotonic()
+            wave_started = _monotonic()
             calls = [
                 self.protocol.call_find_value(shortlist[nid], key_bytes)
                 if find_value
@@ -320,7 +325,7 @@ class DHTNode:
             if all(nid in queried for nid in closest):
                 break
 
-        elapsed = time.monotonic() - lookup_t0
+        elapsed = _monotonic() - lookup_t0
         self.lookup_times.append(elapsed)
         _LOOKUP_SECONDS.observe(elapsed)
         nearest = sorted(responded.items(), key=lambda kv: int(kv[0]) ^ int(target))

@@ -18,6 +18,16 @@ Endpoint = tuple[str, int]
 
 ID_BITS = 160
 
+# Clock seam: bucket freshness (last_updated / last_heard) reads time
+# through here so sim/clock.py can virtualize it (docs/SIMULATION.md).
+_monotonic = time.monotonic
+
+# Entropy seam: ID generation and bucket-refresh targets draw bytes
+# through here so the macro-sim can substitute a seeded source — the
+# refresh target choice steers which peers a lookup visits, so OS
+# entropy here would make whole-swarm runs non-reproducible.
+_urandom = os.urandom
+
 
 class DHTID(int):
     """160-bit Kademlia identifier with XOR distance."""
@@ -26,7 +36,7 @@ class DHTID(int):
 
     @classmethod
     def generate(cls) -> "DHTID":
-        return cls(int.from_bytes(os.urandom(ID_BITS // 8), "big"))
+        return cls(int.from_bytes(_urandom(ID_BITS // 8), "big"))
 
     @classmethod
     def from_key(cls, key: bytes | str) -> "DHTID":
@@ -54,14 +64,14 @@ class KBucket:
         self.lower, self.upper, self.k = lower, upper, k
         self.peers: dict[DHTID, Endpoint] = {}  # insertion-ordered = LRU
         self.replacement: dict[DHTID, Endpoint] = {}
-        self.last_updated = time.monotonic()
+        self.last_updated = _monotonic()
 
     def covers(self, node_id: int) -> bool:
         return self.lower <= node_id < self.upper
 
     def add_or_update(self, node_id: DHTID, endpoint: Endpoint) -> bool:
         """True if stored in the main slots, False if parked as replacement."""
-        self.last_updated = time.monotonic()  # live traffic = bucket not idle
+        self.last_updated = _monotonic()  # live traffic = bucket not idle
         if node_id in self.peers:
             del self.peers[node_id]  # refresh LRU position
             self.peers[node_id] = endpoint
@@ -100,7 +110,7 @@ class KBucket:
 def random_id_in_range(lower: int, upper: int) -> DHTID:
     """Uniform DHTID in [lower, upper) — bucket-refresh lookup targets."""
     span = upper - lower
-    r = int.from_bytes(os.urandom((span.bit_length() + 7) // 8), "big") % span
+    r = int.from_bytes(_urandom((span.bit_length() + 7) // 8), "big") % span
     return DHTID(lower + r)
 
 
@@ -131,7 +141,7 @@ class RoutingTable:
     def add_or_update_node(self, node_id: DHTID, endpoint: Endpoint) -> None:
         if node_id == self.node_id:
             return
-        self.last_heard[node_id] = time.monotonic()
+        self.last_heard[node_id] = _monotonic()
         if len(self.last_heard) > 65536:
             # stamps can reference peers parked-then-dropped from
             # replacement lists (remove_node never fires for those); the

@@ -36,9 +36,14 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
+
+# Clock seam: sim/clock.py swaps this for a virtual clock so the inline
+# refresh path (maybe_refresh) paces on simulated time.
+_monotonic = time.monotonic
 
 
 class AdmissionController:
@@ -69,6 +74,7 @@ class AdmissionController:
         self._load_fn = load_fn
         self.refresh_period_s = refresh_period_s
         self._server_queue_depth = 0.0  # worst advertised depth, cached
+        self._last_refresh: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.shed_total = 0
@@ -98,6 +104,23 @@ class AdmissionController:
         if self._thread is not None:
             self._thread.join(timeout=2 * self.refresh_period_s + 1)
             self._thread = None
+
+    def maybe_refresh(self) -> bool:
+        """Inline alternative to :meth:`start` for single-threaded hosts
+        (the macro-sim): refresh the cached worst-queue snapshot when
+        ``refresh_period_s`` has elapsed on the clock seam.  Returns
+        True when a refresh actually ran."""
+        if self._load_fn is None:
+            return False
+        now = _monotonic()
+        if (
+            self._last_refresh is not None
+            and now - self._last_refresh < self.refresh_period_s
+        ):
+            return False
+        self._last_refresh = now
+        self._refresh_once()
+        return True
 
     def _refresh_once(self) -> None:
         try:

@@ -1,0 +1,199 @@
+"""Arrival traces and churn schedules (ISSUE 18).
+
+One trace format shared by three consumers:
+
+- ``sim/runner.py`` drives the macro-sim's request injector from it on
+  the virtual clock;
+- ``experiments/loadgen.py --trace`` replays the same segment spec
+  against a REAL gateway on the wall clock;
+- ``experiments/dht_swarm_sim.py`` expresses its kill-and-replace
+  rounds as the same :class:`ChurnEvent` schedule the macro-sim uses.
+
+Segment spec grammar (comma-separated, colon-delimited fields)::
+
+    poisson:RATE:DURATION            # stationary Poisson arrivals
+    burst:RATE:DURATION              # alias naming intent (a burst IS a
+                                     # high-rate stationary segment)
+    diurnal:RATE:DURATION:DEPTH:PERIOD
+        # sinusoidal rate swing: rate(t) = RATE * (1 + DEPTH *
+        # sin(2*pi*t/PERIOD)), clipped at 0; DEPTH in [0, 1]
+
+Churn spec grammar (comma-separated)::
+
+    AT:kill:FRACTION                 # at AT seconds, kill FRACTION of
+                                     # the eligible population
+    AT:join:COUNT                    # at AT seconds, add COUNT nodes
+
+Arrival sampling uses Lewis-Shedler thinning against the segment's peak
+rate, so a non-homogeneous (diurnal) segment needs only ``rng.random()``
+draws — deterministic for a seeded ``random.Random`` (or any object with
+a ``random()`` method, e.g. an adapter over ``np.random.RandomState``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterator, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSegment:
+    """One homogeneous-or-sinusoidal stretch of the arrival process."""
+
+    kind: str            # "poisson" | "burst" | "diurnal"
+    rate_hz: float       # mean rate (diurnal: the midline)
+    duration_s: float
+    depth: float = 0.0   # diurnal swing in [0, 1]
+    period_s: float = 0.0
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous rate ``t`` seconds into THIS segment."""
+        if self.kind != "diurnal" or self.period_s <= 0:
+            return self.rate_hz
+        swing = 1.0 + self.depth * math.sin(2.0 * math.pi * t / self.period_s)
+        return max(0.0, self.rate_hz * swing)
+
+    @property
+    def peak_rate_hz(self) -> float:
+        if self.kind == "diurnal":
+            return self.rate_hz * (1.0 + max(0.0, self.depth))
+        return self.rate_hz
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnEvent:
+    """A scheduled population change."""
+
+    at_s: float
+    kind: str            # "kill" | "join"
+    fraction: float = 0.0  # kill: fraction of eligible nodes
+    count: int = 0         # join: number of nodes to add
+
+
+@dataclasses.dataclass(frozen=True)
+class Trace:
+    segments: tuple
+    churn: tuple = ()
+
+    @property
+    def duration_s(self) -> float:
+        return sum(s.duration_s for s in self.segments)
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous arrival rate at absolute trace time ``t``."""
+        for seg in self.segments:
+            if t < seg.duration_s:
+                return seg.rate_at(t)
+            t -= seg.duration_s
+        return 0.0
+
+    def iter_arrivals(self, rng) -> Iterator[float]:
+        """Absolute arrival times over the whole trace, in order.
+
+        Lewis-Shedler thinning per segment: candidate gaps are
+        Exp(peak_rate); a candidate at local time ``t`` survives with
+        probability ``rate_at(t) / peak_rate``.  For stationary
+        segments the acceptance test is a no-op draw skipped entirely,
+        keeping the draw count (and thus the seeded stream) minimal.
+        """
+        offset = 0.0
+        for seg in self.segments:
+            peak = seg.peak_rate_hz
+            if peak <= 0 or seg.duration_s <= 0:
+                offset += seg.duration_s
+                continue
+            stationary = seg.kind != "diurnal" or seg.depth == 0
+            t = 0.0
+            while True:
+                u = rng.random()
+                # inverse-CDF exponential gap; guard log(0)
+                t += -math.log(max(u, 1e-12)) / peak
+                if t >= seg.duration_s:
+                    break
+                if stationary or rng.random() * peak <= seg.rate_at(t):
+                    yield offset + t
+            offset += seg.duration_s
+
+
+def parse_segments(spec: str) -> tuple:
+    """Parse the comma-separated segment spec (see module docstring)."""
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        fields = part.split(":")
+        kind = fields[0]
+        if kind in ("poisson", "burst"):
+            if len(fields) != 3:
+                raise ValueError(
+                    f"segment {part!r}: expected {kind}:RATE:DURATION")
+            out.append(TraceSegment(kind, float(fields[1]), float(fields[2])))
+        elif kind == "diurnal":
+            if len(fields) != 5:
+                raise ValueError(
+                    f"segment {part!r}: expected "
+                    "diurnal:RATE:DURATION:DEPTH:PERIOD")
+            depth = float(fields[3])
+            if not 0.0 <= depth <= 1.0:
+                raise ValueError(f"segment {part!r}: DEPTH must be in [0,1]")
+            out.append(TraceSegment(
+                kind, float(fields[1]), float(fields[2]),
+                depth=depth, period_s=float(fields[4]),
+            ))
+        else:
+            raise ValueError(f"unknown segment kind {kind!r} in {part!r}")
+    if not out:
+        raise ValueError("empty trace spec")
+    return tuple(out)
+
+
+def parse_churn(spec: str) -> tuple:
+    """Parse the churn spec; events are returned sorted by time."""
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        fields = part.split(":")
+        if len(fields) != 3:
+            raise ValueError(
+                f"churn event {part!r}: expected AT:kill:FRACTION or "
+                "AT:join:COUNT")
+        at, kind, val = float(fields[0]), fields[1], fields[2]
+        if kind == "kill":
+            frac = float(val)
+            if not 0.0 < frac <= 1.0:
+                raise ValueError(f"churn {part!r}: FRACTION in (0,1]")
+            out.append(ChurnEvent(at, "kill", fraction=frac))
+        elif kind == "join":
+            out.append(ChurnEvent(at, "join", count=int(val)))
+        else:
+            raise ValueError(f"unknown churn kind {kind!r} in {part!r}")
+    return tuple(sorted(out, key=lambda e: (e.at_s, e.kind)))
+
+
+def parse_trace(segments_spec: str, churn_spec: str = "") -> Trace:
+    return Trace(
+        segments=parse_segments(segments_spec),
+        churn=parse_churn(churn_spec) if churn_spec else (),
+    )
+
+
+def churn_rounds(
+    rounds: int, fraction: float, *, start_s: float = 0.0, every_s: float = 1.0
+) -> tuple:
+    """The dht_swarm_sim shape — N evenly spaced kill-and-replace rounds
+    — expressed as the shared churn schedule."""
+    return tuple(
+        ChurnEvent(start_s + i * every_s, "kill", fraction=fraction)
+        for i in range(int(rounds))
+    )
+
+
+def trace_to_json(trace: Trace) -> dict:
+    """JSON-ready description for embedding in reports (deterministic)."""
+    return {
+        "segments": [
+            {k: v for k, v in dataclasses.asdict(s).items()
+             if v not in (0.0, "") or k in ("kind", "rate_hz", "duration_s")}
+            for s in trace.segments
+        ],
+        "churn": [dataclasses.asdict(e) for e in trace.churn],
+        "duration_s": trace.duration_s,
+    }

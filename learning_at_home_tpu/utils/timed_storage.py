@@ -19,13 +19,19 @@ ValueType = TypeVar("ValueType")
 DHTExpiration = float
 
 
+# Clock seam: every consumer does ``from ... import get_dht_time``, so
+# patching get_dht_time itself would miss them.  The function stays put
+# and sim/clock.py swaps the source underneath (docs/SIMULATION.md).
+_time_source = time.time
+
+
 def get_dht_time() -> DHTExpiration:
     """Wall-clock used for all expirations.
 
     The swarm assumes loosely NTP-synchronized hosts, same as the reference;
-    tests that need determinism monkeypatch this.
+    tests that need determinism monkeypatch ``_time_source``.
     """
-    return time.time()
+    return _time_source()
 
 
 class TimedStorage(Generic[KeyType, ValueType]):

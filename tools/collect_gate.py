@@ -412,6 +412,9 @@ def smoke_worker() -> int:
     rc = dht_smoke()
     if rc:
         return rc
+    rc = macro_sim_smoke()
+    if rc:
+        return rc
     rc = slo_smoke()
     if rc:
         return rc
@@ -456,6 +459,49 @@ def dht_smoke() -> int:
         f"store_reduction={rep['heartbeat']['reduction']}x "
         f"join_mean_ms={rep['join']['mean_ms']}"
     )
+    return 0
+
+
+def macro_sim_smoke() -> int:
+    """Whole-system macro-sim gate (ISSUE 18): a 200-virtual-node swarm
+    (real DHT/scheduler/admission/routing code on the virtual clock)
+    serves a warmup+burst trace through one kill event; the burst must
+    push real admission into shedding (without collapsing), TTFT p99
+    must stay bounded, lookups must keep resolving — and the whole run
+    is byte-deterministic per seed (pinned by tests/test_macro_sim.py;
+    this gate pins the floors stay green end-to-end)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "learning_at_home_tpu.sim.runner",
+                "--nodes", "200", "--servers", "48", "--gateways", "4",
+                "--experts", "64", "--slots", "32",
+                "--trace", "poisson:60:6,burst:480:3",
+                "--churn", "4:kill:0.15",
+                "--check", "--min-completed", "300",
+                "--shed-min", "0.01", "--shed-max", "0.55",
+                "--ttft-p99-max-ms", "45000", "--hit-rate-floor", "0.75",
+            ],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=int(
+                os.environ.get("COLLECT_GATE_MACRO_SIM_TIMEOUT_S", "240")
+            ),
+        )
+    except subprocess.TimeoutExpired:
+        print("collect_gate: macro-sim smoke timed out", file=sys.stderr)
+        return 2
+    ok_line = next(
+        (ln for ln in r.stdout.splitlines()
+         if ln.startswith("MACRO_SIM_OK")), None,
+    )
+    if r.returncode != 0 or ok_line is None:
+        print("collect_gate: FAIL — macro-sim smoke:", file=sys.stderr)
+        print(r.stdout[-1500:], file=sys.stderr)
+        print(r.stderr[-1500:], file=sys.stderr)
+        return r.returncode or 1
+    print(ok_line)
     return 0
 
 
@@ -1080,10 +1126,10 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # ten smokes now (client path, averaging, codec, telemetry+
+            # eleven smokes now (client path, averaging, codec, telemetry+
             # lah_top subprocess, replication, overlap, lifecycle, DHT
-            # swarm sim, SLO churn harness, serving gateway): a wider
-            # bound than the gate's
+            # swarm sim, whole-system macro-sim, SLO churn harness,
+            # serving gateway): a wider bound than the gate's
             timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "1200")),
         )
     except subprocess.TimeoutExpired:
@@ -1099,6 +1145,7 @@ def run_smoke() -> int:
         or "OVERLAP_SMOKE_OK" not in r.stdout
         or "LIFECYCLE_SMOKE_OK" not in r.stdout
         or "DHT_SMOKE_OK" not in r.stdout
+        or "MACRO_SIM_OK" not in r.stdout
         or "SLO_SMOKE_OK" not in r.stdout
         or "GATEWAY_SMOKE_OK" not in r.stdout
     ):
