@@ -315,7 +315,7 @@ def run_macro_sim_bench(
 # HEAD against this rev back-to-back on the SAME box, because absolute
 # CPU numbers vary ±35% across sandbox sessions and only a same-session
 # A/B is code-regression evidence (BASELINE.md round-4 investigation).
-PREV_ROUND_REV = "5edc570"
+PREV_ROUND_REV = "0416cc1"
 
 
 def check_orphan_servers() -> dict | None:
@@ -978,8 +978,11 @@ def dispatch_worker() -> None:
         # steady state: the first few calls include warmup
         return np.asarray(moe.dispatch_times)[warmup:]
 
+    from learning_at_home_tpu.utils.sketch import percentile
+
     def p(times: np.ndarray, q: float) -> float:
-        return round(float(np.percentile(times, q)) * 1e3, 2)
+        # shared percentile engine (ISSUE 19): "linear" == np.percentile
+        return round(percentile(list(times), q, method="linear") * 1e3, 2)
 
     hid, rows = 64, 64
     from learning_at_home_tpu.client.rpc import set_dispatch_mode
@@ -1023,6 +1026,32 @@ def dispatch_worker() -> None:
             if legacy_p50 else None,
             "dispatch_ab_pairs": ab_pairs,
         }
+        # Observability-parity A/B (ISSUE 19): the SAME interleaved-pairs
+        # protocol, toggling the registry histograms' sketch backing
+        # (tracing stays off — the A/B contract is registry-always-on,
+        # tracing-off).  The ratio is the evidence that the sketch-backed
+        # registry costs ~nothing on the hot path; it must sit inside the
+        # BASELINE.md same-session noise band.
+        from learning_at_home_tpu.utils.metrics import set_sketch_backing
+
+        obs_mode: dict = {"plain": [], "sketch": []}
+        try:
+            for _ in range(ab_pairs):
+                for obs, on in (("plain", False), ("sketch", True)):
+                    set_sketch_backing(on)
+                    n0 = len(moe.dispatch_times)
+                    measure(moe, rows, hid, n_dispatch=per_arm, warmup=0)
+                    obs_mode[obs].extend(list(moe.dispatch_times)[n0:])
+        finally:
+            set_sketch_backing(True)  # production default
+        obs_plain_p50 = p(np.asarray(obs_mode["plain"]), 50)
+        obs_sketch_p50 = p(np.asarray(obs_mode["sketch"]), 50)
+        out["obs_plain_p50_ms"] = obs_plain_p50
+        out["obs_sketch_p50_ms"] = obs_sketch_p50
+        out["obs_sketch_vs_plain"] = (
+            round(obs_sketch_p50 / obs_plain_p50, 3)
+            if obs_plain_p50 else None
+        )
         # client hot-path counters: serialize-vs-wait breakdown, bytes the
         # pack-once fan-out did not re-encode, mux in-flight depth
         out.update({
@@ -1372,8 +1401,11 @@ def _codec_chaos_ab(measure, make_moe_l_kwargs: dict) -> dict:
                 measure(m, rows, hid, n_dispatch=1, warmup=0, seed=3,
                         forward_only=True)
         def p50(m):
-            t = np.asarray(m.dispatch_times)[1:]
-            return round(float(np.percentile(t, 50)) * 1e3, 2)
+            # shared percentile engine (ISSUE 19): "linear"==np.percentile
+            from learning_at_home_tpu.utils.sketch import percentile
+
+            t = list(m.dispatch_times)[1:]
+            return round(percentile(t, 50, method="linear") * 1e3, 2)
 
         out["chaos_bandwidth_bps"] = bw
         out["chaos_dispatch_p50_ms_none"] = p50(moes["none"])

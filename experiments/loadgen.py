@@ -51,6 +51,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -62,7 +63,37 @@ from learning_at_home_tpu.utils import sanitizer  # noqa: E402
 
 
 def _pct(values, q) -> float:
-    return float(np.percentile(np.asarray(values), q)) if values else 0.0
+    # shared percentile engine (ISSUE 19): "linear" reproduces
+    # np.percentile's lerp bit-for-bit — pinned by tests/test_sketch.py
+    from learning_at_home_tpu.utils.sketch import percentile
+
+    return percentile(values, q, method="linear", default=0.0)
+
+
+def check_floors(
+    report: dict, *, min_completed: int = 1, max_shed: int = 0,
+    max_errors: int = 0, ttft_p99_max_ms: Optional[float] = None,
+) -> list:
+    """Declarative floors over a :func:`run_load` report (ISSUE 19):
+    the same ``Threshold`` / ``evaluate_thresholds`` engine as the
+    rebalancer's SLO gate and the macro-sim ``--check`` ceilings, so
+    collect_gate smokes assert loadgen health through one evaluator.
+    Returns failure detail strings (empty = healthy)."""
+    from learning_at_home_tpu.utils.slo import Threshold, evaluate_thresholds
+
+    specs = [
+        Threshold("completed_floor", "completed", ">=",
+                  float(min_completed)),
+        Threshold("shed_ceiling", "shed", "<=", float(max_shed)),
+        Threshold("errors_ceiling", "errors", "<=", float(max_errors)),
+        Threshold("crashes_zero", "crashes", "<=", 0.0),
+    ]
+    if ttft_p99_max_ms is not None:
+        specs.append(
+            Threshold("ttft_p99_ceiling", "ttft_p99_ms", "<=",
+                      float(ttft_p99_max_ms))
+        )
+    return [v["detail"] for v in evaluate_thresholds(report, specs)]
 
 
 def parse_len_dist(spec: str) -> list:

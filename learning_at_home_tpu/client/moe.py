@@ -56,7 +56,7 @@ from learning_at_home_tpu.client.rpc import (
     dispatch_mode,
     pool_registry,
 )
-from learning_at_home_tpu.utils import sanitizer
+from learning_at_home_tpu.utils import flight, sanitizer
 from learning_at_home_tpu.utils.connection import (
     QUORUM_STRAGGLER_CANCEL,
     RemoteCallError,
@@ -915,9 +915,17 @@ class RemoteMixtureOfExperts:
                 self._sessions[cid] = (session, dropped.copy(), trace)
                 while len(self._sessions) > self.max_sessions:
                     self._sessions.popitem(last=False)
-        self.dispatch_times.append(
-            (t_end if t_end is not None else _time.monotonic()) - t0
-        )
+        dispatch_s = (t_end if t_end is not None else _time.monotonic()) - t0
+        self.dispatch_times.append(dispatch_s)
+        # sketch-backed registry histogram (ISSUE 19): feeds TRUE fleet
+        # dispatch-latency quantiles via mergeable sketches in telemetry,
+        # alongside the deque-based single-process p50/p99 above
+        from learning_at_home_tpu.utils.metrics import registry as _registry
+
+        _registry.histogram(
+            "lah_client_dispatch_seconds",
+            "end-to-end dispatch latency (fire → join done)",
+        ).observe(dispatch_s)
         self.dispatches += 1
         return y, idx, mask, np.int32(cid)
 
@@ -1389,6 +1397,9 @@ class RemoteMixtureOfExperts:
         deadline (or failed) and the backup replica is being dispatched."""
         self.hedge_fires += 1
         timeline.count("client.hedge.fires")
+        flight.record(
+            "client", "hedge_fire", primary=str(primary), backup=str(backup)
+        )
         logger.debug("hedge fired: primary %s → backup %s", primary, backup)
 
     @sanitizer.runs_on("not:lah-runtime", site="moe.hedge_arm")

@@ -135,6 +135,14 @@ def _watchdog_fire(budget: float, what: str) -> None:
         if _watchdog_fired:
             return
         _watchdog_fired = True
+    # a fired watchdog is exactly the moment the recent-event ring matters:
+    # persist it before anyone restarts the process (ISSUE 19 layer 4)
+    from learning_at_home_tpu.utils import flight
+
+    flight.record(
+        "client", "dispatch_watchdog", what=what, budget_s=round(budget, 3)
+    )
+    flight.dump("dispatch_watchdog")
     logger.warning(
         "dispatch-wait watchdog: %s has waited > %.2fs (watchdog budget = "
         "LAH_DISPATCH_WATCHDOG_MULT x pool RTT-EMA).  If this never "

@@ -40,12 +40,28 @@ class ExpertCoalescer:
 
     def __init__(self, coalesce: bool = True):
         self.coalesce = coalesce
+        # serving-trace hook (ISSUE 19): ``fn(stream_id) -> trace | None``
+        # — the gateway wires the scheduler's ``trace_of`` here so each
+        # group dispatch's ``client.dispatch.{fire,join}`` spans nest
+        # under the stream trace that anchored the group
+        self.trace_lookup = None
         # one inc per fired group dispatch
         self.group_dispatches_total = 0
         # per-stream dispatches AVOIDED by grouping: Σ (group size - 1)
         self.coalesced_dispatches_total = 0
         self.rows_dispatched_total = 0
         self.preview_failures_total = 0
+
+    def _group_trace(self, group):
+        """First member stream's trace id (a coalesced dispatch serves
+        many streams; the wire spans ride the anchoring member's trace)."""
+        if self.trace_lookup is None:
+            return None
+        for s in group:
+            trace = self.trace_lookup(s)
+            if trace is not None:
+                return trace
+        return None
 
     # decoder hook signature: (layer, moe, gate_params, x_rows, row_streams)
     def dispatch(self, layer, moe, gate_params, x_rows, row_streams):
@@ -67,7 +83,8 @@ class ExpertCoalescer:
                 sorted(r for s in group for r in stream_rows[s]), np.int64
             )
             fut = moe.dispatch_async(
-                x_np[rows], logits_np[rows], store_session=False
+                x_np[rows], logits_np[rows], store_session=False,
+                trace=self._group_trace(group),
             )
             fired.append((rows, fut))
         out = np.zeros((x_np.shape[0], x_np.shape[1]), x_np.dtype)

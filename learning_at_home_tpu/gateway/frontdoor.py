@@ -9,13 +9,16 @@ in msgpack meta, never as tensors — a generate stream moves a few ints
 per poll, not megabyte activations):
 
 - ``gen_submit`` {prompt: [int], max_new_tokens, seed?, temperature?,
-  top_p?, top_k?} → {"accepted": true, "sid"} or
+  top_p?, top_k?, trace?} → {"accepted": true, "sid", "trace"?} or
   {"accepted": false, "shed": true, "retry_after_s", "message"}
   (the four optional sampling fields select counter-based sampled
-  decoding; all absent = greedy, the legacy wire shape unchanged)
+  decoding; all absent = greedy, the legacy wire shape unchanged.
+  ``trace`` is an optional 16-hex stream trace id — a valid one is
+  echoed and stamped on every lifecycle span, a malformed one is
+  dropped, and with profiling on the gateway mints one itself)
 - ``gen_poll``   {sid, cursor} → {"tokens": [int], "cursor", "done",
-  "error"?} (tokens from ``cursor`` on; poll again from the returned
-  cursor — replies are immediate, never held)
+  "error"?, "trace"?} (tokens from ``cursor`` on; poll again from the
+  returned cursor — replies are immediate, never held)
 - ``gen_cancel`` {sid} → {"cancelled": bool}
 - ``stats``      {} → gateway counters + the metrics registry snapshot
 
@@ -47,7 +50,14 @@ from learning_at_home_tpu.models.drafter import (
 )
 from learning_at_home_tpu.models.sampling import SamplingParams
 from learning_at_home_tpu.models.swarm_decoder import SwarmKVDecoder
+from learning_at_home_tpu.utils import flight
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
+from learning_at_home_tpu.utils.profiling import (
+    new_trace_id,
+    timeline,
+    valid_trace_id,
+)
+from learning_at_home_tpu.utils.slo import BurnRateSLO, SLOEvaluator
 from learning_at_home_tpu.utils.serialization import (
     WireTensors,
     pack_frames,
@@ -145,6 +155,9 @@ class Gateway:
             prefill_chunk_tokens=prefill_chunk_tokens,
             spec_k=spec_k, drafter=drafter,
         )
+        # stream traces nest the coalescer's client.dispatch.{fire,join}
+        # spans under the submitting stream (ISSUE 19 layer 1)
+        self.coalescer.trace_lookup = self.scheduler.trace_of
         # server-load feed: the MoE's own cost model already TTL-caches
         # the load.<prefix> heartbeats (PR 8) — reuse it instead of
         # growing a second DHT reader.  loads() blocks on the refresh
@@ -175,6 +188,45 @@ class Gateway:
 
         self._collector_key = f"gateway-{id(self)}"
         registry.register_collector(self._collector_key, self._collect)
+        # declarative TTFT SLO (ISSUE 19 layer 3): the scheduler counts
+        # first-token events against the target; burn-rate evaluation
+        # runs at scrape time on the lah-metrics loop, and entering PAGE
+        # dumps a flight-recorder artifact.  Env knobs exist so smokes
+        # and operators can tighten without code changes.
+        def _env_float(name: str, default: float) -> float:
+            try:
+                return float(os.environ.get(name, default))
+            except ValueError:
+                return default
+
+        self.ttft_slo_target_s = _env_float("LAH_TTFT_SLO_S", 30.0)
+        self.scheduler.ttft_target_s = self.ttft_slo_target_s
+        self.slo = SLOEvaluator(component="gateway")
+        sched = self.scheduler
+        self.slo.register(
+            BurnRateSLO(
+                name="gateway_ttft",
+                objective=min(
+                    0.999999,
+                    max(1e-6, _env_float("LAH_TTFT_SLO_OBJECTIVE", 0.99)),
+                ),
+                fast_window_s=_env_float("LAH_SLO_FAST_S", 60.0),
+                slow_window_s=max(
+                    _env_float("LAH_SLO_FAST_S", 60.0),
+                    _env_float("LAH_SLO_SLOW_S", 600.0),
+                ),
+                description=(
+                    f"TTFT <= {self.ttft_slo_target_s:g}s for the "
+                    "objective fraction of streams"
+                ),
+            ),
+            lambda: (
+                sched.ttft_events_total - sched.ttft_slow_total,
+                sched.ttft_slow_total,
+            ),
+        )
+        self._slo_collector_key = f"slo-gateway-{id(self)}"
+        registry.register_collector(self._slo_collector_key, self.slo.collect)
         self.telemetry = None
         if dht is not None:
             from learning_at_home_tpu.utils.telemetry import (
@@ -202,6 +254,7 @@ class Gateway:
         from learning_at_home_tpu.utils.metrics import registry
 
         registry.unregister_collector(self._collector_key)
+        registry.unregister_collector(self._slo_collector_key)
         if self.telemetry is not None:
             self.telemetry.stop()
             self.telemetry = None
@@ -398,6 +451,14 @@ class Gateway:
             return reply("error", {"message": f"{type(e).__name__}: {e}"})
 
     def _gen_submit(self, meta: dict) -> dict:
+        # per-stream trace id (ISSUE 19): echo a structurally valid
+        # client-supplied id, mint one only while profiling is on (the
+        # disabled path stays allocation-free), drop anything malformed
+        trace = meta.get("trace")
+        if not valid_trace_id(trace):
+            trace = None
+        if trace is None and timeline.enabled:
+            trace = new_trace_id()
         prompt = meta.get("prompt")
         max_new = meta.get("max_new_tokens")
         vocab = self.model.cfg.vocab_size
@@ -483,18 +544,31 @@ class Gateway:
                 f" KV pages but the pool holds "
                 f"{self.decoder.kv.pages_total()}"
             )
-        accepted, retry_after_s, reason = self.admission.admit(
-            pages_needed=pages_needed
-        )
+        with timeline.span("gateway.admit", trace=trace):
+            accepted, retry_after_s, reason = self.admission.admit(
+                pages_needed=pages_needed
+            )
         if not accepted:
-            return {
+            flight.record(
+                "gateway", "shed", reason=reason,
+                retry_after_s=retry_after_s, pages_needed=pages_needed,
+            )
+            out = {
                 "accepted": False,
                 "shed": True,
                 "retry_after_s": retry_after_s,
                 "message": reason,
             }
-        sid = self.scheduler.submit(prompt, max_new, sampling=sampling)
-        return {"accepted": True, "sid": sid}
+            if trace is not None:
+                out["trace"] = trace
+            return out
+        sid = self.scheduler.submit(
+            prompt, max_new, sampling=sampling, trace=trace
+        )
+        out = {"accepted": True, "sid": sid}
+        if trace is not None:
+            out["trace"] = trace
+        return out
 
 
 class GatewayClient:
@@ -517,12 +591,14 @@ class GatewayClient:
 
     def submit(self, prompt, max_new_tokens: int, *,
                seed=None, temperature=None, top_p=None,
-               top_k=None) -> dict:
+               top_k=None, trace=None) -> dict:
         """One admission attempt; the reply is either accepted ({sid}) or
         a shed ({shed, retry_after_s}).  Raises RemoteCallError only for
         INVALID requests — backpressure is a normal reply.  The sampling
         kwargs ride as optional gen_submit fields (all None = greedy,
-        and the wire frame carries no sampling keys at all)."""
+        and the wire frame carries no sampling keys at all).  ``trace``
+        optionally carries a caller-minted 16-hex trace id; the gateway
+        echoes it in the reply and stamps it on every lifecycle span."""
         meta = {
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
@@ -535,6 +611,8 @@ class GatewayClient:
             meta["top_p"] = float(top_p)
         if top_k is not None:
             meta["top_k"] = int(top_k)
+        if trace is not None:
+            meta["trace"] = str(trace)
         return self._rpc("gen_submit", meta)
 
     def poll(self, sid: str, cursor: int = 0) -> dict:

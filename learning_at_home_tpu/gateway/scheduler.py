@@ -60,7 +60,9 @@ from collections import deque
 from typing import Optional
 
 from learning_at_home_tpu.models.kv_pages import PagePressure
-from learning_at_home_tpu.utils import sanitizer
+from learning_at_home_tpu.utils import flight, sanitizer
+from learning_at_home_tpu.utils.metrics import registry
+from learning_at_home_tpu.utils.profiling import timeline
 
 logger = logging.getLogger(__name__)
 
@@ -120,6 +122,13 @@ class StreamState:
     )
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # serving-trace id (ISSUE 19): rides every lifecycle span + poll reply
+    trace: Optional[str] = None
+    # times this stream lost its slot (>0 ⇒ next admit is a recompute)
+    preemptions: int = 0
+    # last time the stream entered the pending queue (submit or preempt
+    # requeue) — start of the "pending wait" span recorded at slot assign
+    queued_at: float = 0.0
 
 
 class SlotScheduler:
@@ -197,6 +206,12 @@ class SlotScheduler:
         self.spec_tokens_total = 0
         self.spec_draft_seconds_total = 0.0
         self.spec_verify_seconds_total = 0.0
+        # TTFT SLO feed (utils/slo.py burn-rate evaluator): every first
+        # token counts one event; slower than ``ttft_target_s`` counts it
+        # bad.  The Gateway sets the target from its SLO spec.
+        self.ttft_target_s: Optional[float] = None
+        self.ttft_events_total = 0
+        self.ttft_slow_total = 0
         # decode-step wall time EMA (seconds) — the admission controller's
         # retry-after scale
         self.step_time_ema: Optional[float] = None
@@ -230,16 +245,21 @@ class SlotScheduler:
 
     # ---- front-door surface (any thread/loop; short lock sections) ----
 
-    def submit(self, prompt, max_new_tokens: int, sampling=None) -> str:
+    def submit(
+        self, prompt, max_new_tokens: int, sampling=None, trace=None
+    ) -> str:
         """Enqueue a stream; returns its sid.  Admission (shed/accept) is
         the caller's job — this never refuses.  ``sampling`` is an
         optional :class:`~learning_at_home_tpu.models.sampling.
-        SamplingParams` (None = greedy)."""
+        SamplingParams` (None = greedy); ``trace`` an optional validated
+        16-hex serving-trace id stamped onto every lifecycle span."""
         sid = f"s{next(self._sid_counter)}-{self._sid_salt}"
         st = StreamState(
             sid=sid, prompt=list(prompt),
             max_new_tokens=int(max_new_tokens), sampling=sampling,
+            trace=trace,
         )
+        st.queued_at = st.submitted_at
         with self._lock:
             self._streams[sid] = st
             self._pending.append(sid)
@@ -254,13 +274,23 @@ class SlotScheduler:
             if st is None:
                 return None
             cursor = max(0, int(cursor))
-            return {
+            reply = {
                 "sid": sid,
                 "tokens": list(st.tokens[cursor:]),
                 "cursor": cursor + len(st.tokens[cursor:]),
                 "done": st.done,
                 "error": st.error,
             }
+            if st.trace is not None:
+                reply["trace"] = st.trace
+            return reply
+
+    def trace_of(self, sid: str) -> Optional[str]:
+        """Serving-trace id for a live stream, or None.  Lock-free read
+        (GIL-atomic dict get on an immutable-per-stream field) so the
+        coalescer may call it from the decode thread mid-step."""
+        st = self._streams.get(sid)
+        return st.trace if st is not None else None
 
     def cancel(self, sid: str) -> bool:
         with self._lock:
@@ -332,6 +362,8 @@ class SlotScheduler:
                 ),
                 "prefill_chunks_total": self.decoder.prefill_chunks_total,
                 "preemptions_total": self.preemptions_total,
+                "ttft_events_total": self.ttft_events_total,
+                "ttft_slow_total": self.ttft_slow_total,
                 "spec_k": self.spec_k if self.speculative else 0,
                 "spec_rounds_total": self.spec_rounds_total,
                 "spec_proposed_total": self.spec_proposed_total,
@@ -407,6 +439,22 @@ class SlotScheduler:
                 self.streams_cancelled_total += 1
             else:
                 self.streams_finished_total += 1
+        # reached once per stream (the idempotency return above guards
+        # re-entry): the umbrella span every other lifecycle span nests
+        # under by time containment, plus an outcome marker
+        if timeline.enabled:
+            timeline.record(
+                "gateway.stream", st.submitted_at,
+                max(0.0, now - st.submitted_at), trace=st.trace,
+            )
+            if cancelled:
+                timeline.record(
+                    "gateway.stream.cancel", now, 0.0, trace=st.trace
+                )
+            elif error is not None:
+                timeline.record(
+                    "gateway.stream.error", now, 0.0, trace=st.trace
+                )
 
     def _evict_cancelled(self, now: float) -> None:
         with self._lock:
@@ -492,17 +540,20 @@ class SlotScheduler:
                                      st.sid)
                     self._finish(st, now, error=f"{type(e).__name__}: {e}")
                     continue
+                self._record_admit_spans(st, _monotonic())
                 with self._lock:
                     st.slot = free[0]
                     st.prefilling = True
                 continue
             # serial prefill (dense decoder, or chunking disabled for the
             # legacy bench arm)
+            t_assign = _monotonic()
             try:
-                tok = self.decoder.prefill_into_slot(
-                    free[0], prompt, stream_id=st.sid,
-                    sampling=st.sampling,
-                )
+                with timeline.span("gateway.prefill", trace=st.trace):
+                    tok = self.decoder.prefill_into_slot(
+                        free[0], prompt, stream_id=st.sid,
+                        sampling=st.sampling,
+                    )
             except PagePressure:
                 self.decoder.evict(free[0])
                 with self._lock:
@@ -512,23 +563,61 @@ class SlotScheduler:
                 logger.exception("prefill failed for stream %s", st.sid)
                 self._finish(st, now, error=f"{type(e).__name__}: {e}")
                 continue
+            self._record_admit_spans(st, t_assign)
             self._stream_got_token(st, free[0], tok, now)
+
+    def _record_admit_spans(self, st: StreamState, t_assign: float) -> None:
+        """Slot-assign spans: the pending wait this stream just completed
+        plus an instant admit marker — named ``gateway.recompute.admit``
+        when the admit re-runs a preempted stream's token-identical
+        prefill (ISSUE 19 trace continuity through preemption)."""
+        if not timeline.enabled:
+            return
+        timeline.record(
+            "gateway.pending.wait", st.queued_at,
+            max(0.0, t_assign - st.queued_at), trace=st.trace,
+        )
+        name = (
+            "gateway.recompute.admit" if st.preemptions
+            else "gateway.slot.assign"
+        )
+        timeline.record(name, t_assign, 0.0, trace=st.trace)
 
     def _stream_got_token(self, st: StreamState, slot: int, tok: int,
                           now: float) -> None:
         """A prefill produced st's next token: record it, turn the slot
         live on the table side, finish if the budget is already met."""
+        ttft = None
         with self._lock:
             st.slot = slot
             st.prefilling = False
             if st.first_token_at is None:
                 st.first_token_at = _monotonic()
+                ttft = st.first_token_at - st.submitted_at
+                self.ttft_events_total += 1
+                if (
+                    self.ttft_target_s is not None
+                    and ttft > self.ttft_target_s
+                ):
+                    self.ttft_slow_total += 1
             st.tokens.append(tok)
             self.tokens_total += 1
             full = (
                 len(st.tokens) >= st.max_new_tokens
                 or self.decoder.at_capacity(slot)
             )
+        if ttft is not None:
+            registry.histogram(
+                "lah_gateway_ttft_seconds",
+                "time to first token per stream (submit → first token)",
+            ).observe(ttft)
+            if timeline.enabled:
+                timeline.record(
+                    "gateway.token.first", st.first_token_at, 0.0,
+                    trace=st.trace,
+                )
+        elif timeline.enabled:
+            timeline.record("gateway.token", now, 0.0, trace=st.trace)
         if full:
             self._finish(st, now)
 
@@ -565,7 +654,8 @@ class SlotScheduler:
             if st.cancelled:  # next _evict_cancelled pass finishes it
                 continue
             try:
-                consumed, tok = self.decoder.prefill_step(slot, budget)
+                with timeline.span("gateway.prefill.chunk", trace=st.trace):
+                    consumed, tok = self.decoder.prefill_step(slot, budget)
             except PagePressure:
                 # the raiser is NOT excluded from the victim pool: if it
                 # is itself the youngest slotted stream it gets requeued,
@@ -612,11 +702,23 @@ class SlotScheduler:
                 key=lambda st: st.first_token_at or st.submitted_at,
             )
         self.decoder.evict(victim.slot)
+        t_evict = _monotonic()
         with self._lock:
             victim.slot = None
             victim.prefilling = False
+            victim.preemptions += 1
+            victim.queued_at = t_evict
+            tokens_redone = len(victim.tokens)
             self._pending.appendleft(victim.sid)
         self.preemptions_total += 1
+        flight.record(
+            "gateway", "preempt", sid=victim.sid,
+            tokens_redone=tokens_redone, preemptions=victim.preemptions,
+        )
+        if timeline.enabled:
+            timeline.record(
+                "gateway.preempt", t_evict, 0.0, trace=victim.trace
+            )
         logger.info("gateway preempted stream %s under page pressure",
                     victim.sid)
         return True
@@ -662,6 +764,9 @@ class SlotScheduler:
             dt if self.step_time_ema is None
             else 0.8 * self.step_time_ema + 0.2 * dt
         )
+        profiled = timeline.enabled
+        if profiled:
+            timeline.record("gateway.decode.step", t0, dt)
         finished = []
         with self._lock:
             for slot, sid in live:
@@ -673,6 +778,10 @@ class SlotScheduler:
                     continue
                 st.tokens.append(int(nxt[slot]))
                 self.tokens_total += 1
+                if profiled:
+                    timeline.record(
+                        "gateway.token", now, 0.0, trace=st.trace
+                    )
                 if (
                     len(st.tokens) >= st.max_new_tokens
                     or self.decoder.at_capacity(slot)
@@ -732,7 +841,10 @@ class SlotScheduler:
                 )
                 drafts = drafts[:covered]
             proposals[slot] = drafts
-        self.spec_draft_seconds_total += _monotonic() - t_draft
+        draft_dt = _monotonic() - t_draft
+        self.spec_draft_seconds_total += draft_dt
+        if timeline.enabled:
+            timeline.record("gateway.spec.draft", t_draft, draft_dt)
         t0 = _monotonic()
         try:
             results = self.decoder.verify_step(proposals)
@@ -751,6 +863,9 @@ class SlotScheduler:
             dt if self.step_time_ema is None
             else 0.8 * self.step_time_ema + 0.2 * dt
         )
+        profiled = timeline.enabled
+        if profiled:
+            timeline.record("gateway.spec.verify", t0, dt)
         finished = []
         with self._lock:
             for slot, sid in live:
@@ -767,6 +882,14 @@ class SlotScheduler:
                 self.spec_proposed_total += res["proposed"]
                 self.spec_accepted_total += res["accepted"]
                 self.spec_tokens_total += len(res["tokens"])
+                if profiled:
+                    # accepted-k rides the span name: one instant marker
+                    # per stream per verify round (k is bounded by spec_k
+                    # so the name set stays small)
+                    timeline.record(
+                        f"gateway.spec.accept.k{res['accepted']}",
+                        now, 0.0, trace=st.trace,
+                    )
                 for tok in res["tokens"]:
                     st.tokens.append(int(tok))
                     self.tokens_total += 1
@@ -792,8 +915,12 @@ class SlotScheduler:
                 if st.done and st.finished_at is not None
                 and now - st.finished_at > self.stream_ttl_s
             ]
+            traces = [self._streams[sid].trace for sid in stale]
             for sid in stale:
                 del self._streams[sid]
+        if timeline.enabled:
+            for tr in traces:
+                timeline.record("gateway.stream.gc", now, 0.0, trace=tr)
         if stale:
             logger.info("gateway stream GC dropped %d stale results",
                         len(stale))

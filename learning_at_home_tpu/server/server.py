@@ -34,7 +34,7 @@ from learning_at_home_tpu.server.expert_backend import ExpertBackend
 from learning_at_home_tpu.server.lifecycle import HandoffReceiver
 from learning_at_home_tpu.server.runtime import Runtime
 from learning_at_home_tpu.server.task_pool import TaskPool
-from learning_at_home_tpu.utils import sanitizer
+from learning_at_home_tpu.utils import flight, sanitizer
 from learning_at_home_tpu.utils.asyncio_utils import BackgroundLoop
 
 logger = logging.getLogger(__name__)
@@ -728,11 +728,19 @@ class Server:
                 return True
             self.lifecycle_state = lifecycle.DRAINING
             self.draining_since = time.monotonic()
-            return False
+        flight.record(
+            "server", "drain_transition", state=lifecycle.DRAINING,
+            port=self.port,
+        )
+        return False
 
     def _finish_drain(self) -> None:
         with self._lifecycle_lock:
             self.lifecycle_state = lifecycle.DRAINED
+        flight.record(
+            "server", "drain_transition", state=lifecycle.DRAINED,
+            port=self.port,
+        )
         self._drained.set()
 
     @sanitizer.runs_on("host", site="server.drain")

@@ -113,6 +113,12 @@ SEAMS: tuple[tuple[str, str, str], ...] = (
     ("learning_at_home_tpu.dht.node", "_monotonic", "monotonic"),
     ("learning_at_home_tpu.dht.routing", "_monotonic", "monotonic"),
     ("learning_at_home_tpu.client.routing", "_monotonic", "monotonic"),
+    # flight-recorder event timestamps + SLO burn-rate windows (ISSUE
+    # 19): both must advance on the virtual clock so sim scenarios emit
+    # deterministic flight rings and drive burn-rate transitions without
+    # wall-clock waits.
+    ("learning_at_home_tpu.utils.flight", "_monotonic", "monotonic"),
+    ("learning_at_home_tpu.utils.slo", "_monotonic", "monotonic"),
     # get_dht_time() — record expirations.  Every importer does
     # ``from ... import get_dht_time``, so the function stays put and
     # only its internal _time_source is swapped.

@@ -63,11 +63,13 @@ DEFAULT_PREFIX = "sim_ffn"
 
 
 def _pct(values, q) -> float:
-    if not values:
-        return 0.0
-    vs = sorted(values)
-    idx = min(len(vs) - 1, max(0, int(round((q / 100.0) * (len(vs) - 1)))))
-    return vs[idx]
+    # shared percentile engine (ISSUE 19): "nearest" reproduces the
+    # macro-sim's original nearest-rank formula exactly (banker's
+    # rounding included) — the report stays byte-deterministic per seed
+    # (pinned by tests/test_sketch.py against the old inline formula)
+    from learning_at_home_tpu.utils.sketch import percentile
+
+    return percentile(values, q, method="nearest", default=0.0)
 
 
 def canonical_json(obj) -> str:
@@ -574,7 +576,16 @@ def run_macro_sim(
 
 
 def check_report(report: dict, args) -> list:
-    """Regression floors; returns failure strings (empty = pass)."""
+    """Regression floors; returns failure strings (empty = pass).
+
+    The numeric floors/ceilings are declarative :class:`Threshold` specs
+    run through the shared SLO engine (utils/slo.py, ISSUE 19) — same
+    evaluator as the rebalancer's gate and the loadgen floors; bounds
+    and failure messages unchanged.  The arrivals-accounting identity
+    stays inline (it is an equality over three fields, not a
+    threshold)."""
+    from learning_at_home_tpu.utils.slo import Threshold, evaluate_thresholds
+
     failures = []
     tr = report["traffic"]
     accounted = tr["completed"] + tr["shed"] + tr["errored"]
@@ -583,34 +594,45 @@ def check_report(report: dict, args) -> list:
             f"accounting: completed+shed+errored {accounted} "
             f"!= arrivals {tr['arrivals']}"
         )
-    if tr["errored"]:
-        failures.append(f"errored streams: {tr['errored']}")
-    if tr["completed"] < args.min_completed:
-        failures.append(
+    specs = [
+        Threshold("errored_zero", "traffic.errored", "<=", 0.0),
+        Threshold("completed_floor", "traffic.completed", ">=",
+                  float(args.min_completed)),
+        Threshold("shed_floor", "traffic.shed_fraction", ">=",
+                  float(args.shed_min)),
+        Threshold("shed_ceiling", "traffic.shed_fraction", "<=",
+                  float(args.shed_max)),
+        Threshold("ttft_p99_ceiling", "traffic.ttft_p99_ms", "<=",
+                  float(args.ttft_p99_max_ms)),
+        Threshold("hit_rate_floor", "dht.hit_rate", ">=",
+                  float(args.hit_rate_floor)),
+        Threshold("join_failures_zero", "swarm.join_failures", "<=", 0.0),
+    ]
+    messages = {
+        "errored_zero": f"errored streams: {tr['errored']}",
+        "completed_floor": (
             f"completed {tr['completed']} < floor {args.min_completed}"
-        )
-    if tr["shed_fraction"] < args.shed_min:
-        failures.append(
+        ),
+        "shed_floor": (
             f"shed_fraction {tr['shed_fraction']} < {args.shed_min} "
             "(the burst never pushed admission into shedding)"
-        )
-    if tr["shed_fraction"] > args.shed_max:
-        failures.append(
+        ),
+        "shed_ceiling": (
             f"shed_fraction {tr['shed_fraction']} > {args.shed_max}"
-        )
-    if tr["ttft_p99_ms"] > args.ttft_p99_max_ms:
-        failures.append(
+        ),
+        "ttft_p99_ceiling": (
             f"ttft_p99_ms {tr['ttft_p99_ms']} > {args.ttft_p99_max_ms}"
-        )
-    if report["dht"]["hit_rate"] < args.hit_rate_floor:
-        failures.append(
+        ),
+        "hit_rate_floor": (
             f"lookup hit_rate {report['dht']['hit_rate']} < "
             f"{args.hit_rate_floor}"
-        )
-    if report["swarm"]["join_failures"]:
-        failures.append(
+        ),
+        "join_failures_zero": (
             f"join_failures: {report['swarm']['join_failures']}"
-        )
+        ),
+    }
+    for v in evaluate_thresholds(report, specs):
+        failures.append(messages.get(v["slo"], v["detail"]))
     return failures
 
 

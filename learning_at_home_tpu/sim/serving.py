@@ -46,6 +46,7 @@ from learning_at_home_tpu.dht.protocol import PLAIN_SUBKEY
 from learning_at_home_tpu.gateway.admission import AdmissionController
 from learning_at_home_tpu.gateway.scheduler import SlotScheduler
 from learning_at_home_tpu.sim.net import SIM_HOST, SimNetwork, spawn_node
+from learning_at_home_tpu.utils import flight
 from learning_at_home_tpu.utils.telemetry import (
     MAX_ADVERTISED_LINKS,
     links_key,
@@ -488,6 +489,12 @@ class SimGateway:
         accepted, _retry, _reason = self.adm.admit(pages_needed=pages)
         if not accepted:
             self.shed += 1
+            # virtual-clock-aware flight event (the seam in sim/clock.py
+            # stamps t_mono from the scenario clock)
+            flight.record(
+                f"sim.{self.name}", "shed", reason=_reason, bucket=bucket,
+                pages_needed=pages,
+            )
             return False
         sid = self.sched.submit(prompt, max_new)
         now = self.clock.monotonic()

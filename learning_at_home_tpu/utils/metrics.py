@@ -44,10 +44,22 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence
 
-from learning_at_home_tpu.utils import sanitizer
+from learning_at_home_tpu.utils import flight, sanitizer
 from learning_at_home_tpu.utils.profiling import timeline
+from learning_at_home_tpu.utils.sketch import QuantileSketch
 
 logger = logging.getLogger(__name__)
+
+# Histograms also feed a mergeable quantile sketch per label set (ISSUE
+# 19) so lah_top can compute TRUE fleet percentiles instead of the MAX
+# fallback.  The toggle exists for bench.py's observability-parity A/B
+# only — production never turns it off.
+_SKETCH_BACKING = True
+
+
+def set_sketch_backing(on: bool) -> None:
+    global _SKETCH_BACKING
+    _SKETCH_BACKING = bool(on)
 
 _INVALID_NAME_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -159,6 +171,28 @@ class Histogram(_Metric):
                     state["buckets"][i] += 1
             state["sum"] += value
             state["count"] += 1
+            if _SKETCH_BACKING:
+                sk = state.get("sketch")
+                if sk is None:
+                    sk = state["sketch"] = QuantileSketch()
+                sk.add(value)
+
+    def _items(self) -> list[tuple[tuple, Any]]:
+        # deep-copy under the lock: the live sketch/bucket state mutates
+        # concurrently with scrapes, and the sketch renders to its wire
+        # form here so snapshot()/render_prometheus() never touch it
+        with self._lock:
+            out = []
+            for k, st in self._values.items():
+                view: dict[str, Any] = {
+                    "buckets": list(st["buckets"]),
+                    "sum": st["sum"],
+                    "count": st["count"],
+                }
+                if "sketch" in st:
+                    view["sketch"] = st["sketch"].to_dict()
+                out.append((k, view))
+            return out
 
 
 class MetricsRegistry:
@@ -306,6 +340,13 @@ class MetricsRegistry:
                             str(ub): n
                             for ub, n in zip(m.buckets, st["buckets"])
                         },
+                        # wire-form sketch (already rendered by _items);
+                        # absent on pre-sketch peers — readers treat that
+                        # as the tagged MAX-fallback signal
+                        **(
+                            {"sketch": st["sketch"]}
+                            if "sketch" in st else {}
+                        ),
                     },
                 )
             elif isinstance(m, Gauge):
@@ -389,6 +430,7 @@ def _register_timeline_collector(reg: MetricsRegistry) -> None:
         return out
 
     reg.register_collector("timeline", collect)
+    reg.register_collector("flight", flight.recorder.metrics)
 
 
 _register_timeline_collector(registry)
@@ -409,6 +451,7 @@ class MetricsHTTPServer:
         /trace         {"traceEvents": [...]} — this process's Timeline
                        as Chrome trace_event JSON (empty when profiling
                        is off)
+        /debug/flight  the flight recorder's per-component event rings
         /healthz       "ok"
 
     ``extra_fn`` (optional) is evaluated per ``/metrics.json`` request
@@ -470,6 +513,10 @@ class MetricsHTTPServer:
                     self.meta.get("role") and
                     f"lah-{self.meta['role']}" or None
                 )}
+            ).encode()
+        if path == "/debug/flight":
+            return 200, "application/json", json.dumps(
+                flight.recorder.snapshot()
             ).encode()
         if path == "/healthz":
             return 200, "text/plain", b"ok"

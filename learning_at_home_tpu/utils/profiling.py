@@ -74,6 +74,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
 import threading
 import time
 from collections import defaultdict, deque
@@ -83,11 +84,20 @@ import numpy as np
 
 from learning_at_home_tpu.utils import sanitizer
 
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{16}$")
+
 
 def new_trace_id() -> str:
     """A compact (16 hex chars, 64-bit) globally-unlikely-to-collide trace
     id — small enough to ride in every RPC's msgpack meta."""
     return os.urandom(8).hex()
+
+
+def valid_trace_id(value: object) -> bool:
+    """Structural check for the 16-hex trace-id contract: handlers echo
+    ids that pass, and silently drop anything else (a peer-supplied meta
+    string must never flow into spans/replies unvalidated)."""
+    return isinstance(value, str) and bool(_TRACE_ID_RE.match(value))
 
 
 class Timeline:

@@ -83,6 +83,9 @@ _site_counts: dict[tuple[str, str], int] = {}
 _lock_edges: dict[tuple[str, str], int] = {}
 _stalls = {"count": 0, "max_ms": 0.0, "last": None}
 _tls = threading.local()
+# reentrancy guard for the flight-recorder violation hook (see
+# _record_violation): flight's own lock is sanitizer-instrumented
+_flight_hook = threading.local()
 
 # per-site log throttle so a hot-path regression warns, not firehoses
 _LOG_CAP_PER_SITE = 3
@@ -166,6 +169,24 @@ def _record_violation(kind: str, site: str, detail: str) -> None:
             "sanitizer %s violation at %s (thread %s): %s",
             kind, site, threading.current_thread().name, detail,
         )
+    # flight-recorder hook (ISSUE 19 layer 4): a violation is a dump
+    # trigger — the ring holds the events that led here.  Lazy import
+    # (flight builds its lock through this module) plus a thread-local
+    # reentrancy guard: recording the event takes the flight lock, and a
+    # violation raised BY that acquisition must not recurse back in.
+    if getattr(_flight_hook, "active", False):
+        return
+    _flight_hook.active = True
+    try:
+        from learning_at_home_tpu.utils import flight
+
+        flight.record(
+            "sanitizer", "violation", violation_kind=kind, site=site,
+            detail=detail[:200],
+        )
+        flight.dump("sanitizer_violation")
+    finally:
+        _flight_hook.active = False
 
 
 def check(kind: str, site: str) -> None:

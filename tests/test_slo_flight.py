@@ -1,0 +1,401 @@
+"""Declarative SLO engine + flight recorder + lah_top fleet panels
+(ISSUE 19).
+
+The contracts under test:
+
+- **thresholds**: one comparison engine for every report gate — dotted
+  lookup, fail-closed on missing/non-numeric metrics, violation dicts
+  carrying the offending value;
+- **burn rates**: the multiwindow state machine walks OK → WARN → PAGE
+  on a virtual clock (PAGE needs BOTH windows burning, WARN fires on the
+  slow window alone, recovery returns to OK), transitions land in the
+  flight recorder, and entering PAGE writes a parseable on-disk
+  artifact;
+- **flight recorder**: rings are bounded per component, the component
+  set is bounded with an overflow bucket, dumps are throttled per
+  reason, and ``/debug/flight`` serves the rings as JSON;
+- **lah_top**: fleet quantiles come from merged per-peer sketches
+  (tagged ``sketch+MAX`` when coverage is partial), SLO rows parse the
+  ``lah_slo_*`` series, and malformed peer sections render dashes —
+  never a crash.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from learning_at_home_tpu.utils import flight as flight_mod
+from learning_at_home_tpu.utils import slo as slo_mod
+from learning_at_home_tpu.utils.flight import FlightRecorder
+from learning_at_home_tpu.utils.metrics import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+)
+from learning_at_home_tpu.utils.sketch import QuantileSketch
+from learning_at_home_tpu.utils.slo import (
+    OK,
+    PAGE,
+    WARN,
+    BurnRateSLO,
+    SLOEvaluator,
+    Threshold,
+    evaluate_thresholds,
+    lookup,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import lah_top  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# threshold specs
+# ---------------------------------------------------------------------------
+
+
+def test_thresholds_pass_fail_and_dotted_lookup():
+    report = {"serving": {"ttft_p99_ms": 120.0, "completed": 40}}
+    assert lookup(report, "serving.ttft_p99_ms") == 120.0
+    assert lookup(report, "serving.nope") is None
+    assert lookup(report, "serving.ttft_p99_ms.deeper") is None
+    specs = [
+        Threshold("ttft_ceiling", "serving.ttft_p99_ms", "<=", 200.0),
+        Threshold("completed_floor", "serving.completed", ">=", 100.0),
+    ]
+    violations = evaluate_thresholds(report, specs)
+    assert [v["slo"] for v in violations] == ["completed_floor"]
+    assert violations[0]["value"] == 40.0
+    assert "40" in violations[0]["detail"]
+
+
+def test_thresholds_fail_closed_on_missing_or_non_numeric():
+    specs = [Threshold("ceiling", "a.b", "<=", 1.0)]
+    for report in ({}, {"a": {}}, {"a": {"b": "fast"}}, {"a": {"b": None}}):
+        violations = evaluate_thresholds(report, specs)
+        assert len(violations) == 1 and violations[0]["value"] is None
+        assert "missing or non-numeric" in violations[0]["detail"]
+
+
+def test_threshold_unknown_op_rejected_at_construction():
+    with pytest.raises(ValueError):
+        Threshold("bad", "a", "!=", 1.0)
+
+
+def test_burn_rate_slo_spec_validation():
+    with pytest.raises(ValueError):
+        BurnRateSLO("x", objective=1.0)
+    with pytest.raises(ValueError):
+        BurnRateSLO("x", objective=0.99, fast_window_s=600, slow_window_s=60)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate state machine (virtual clock via the _monotonic seam)
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_walks_ok_warn_ok_page_and_dumps_on_page(
+    monkeypatch, tmp_path
+):
+    clk = {"t": 1000.0}
+    monkeypatch.setattr(slo_mod, "_monotonic", lambda: clk["t"])
+    monkeypatch.setenv("LAH_FLIGHT_DIR", str(tmp_path))
+    flight_mod.recorder.clear()  # drop prior rings + dump throttle state
+
+    counters = {"good": 0.0, "bad": 0.0}
+    ev = SLOEvaluator(component="slo-test")
+    slo = BurnRateSLO(
+        "test_ttft", objective=0.99,
+        fast_window_s=60.0, slow_window_s=600.0,
+        page_burn=14.0, warn_burn=3.0,
+    )
+    ev.register(slo, lambda: (counters["good"], counters["bad"]))
+
+    # healthy traffic → OK, zero burn
+    clk["t"] = 1010.0
+    counters["good"] = 1000.0
+    st = ev.evaluate()["test_ttft"]
+    assert st["state"] == OK and st["fast_burn"] == 0.0
+
+    # a modest bad burst: slow-window burn 3.85 ≥ warn, < page → WARN
+    clk["t"] = 1020.0
+    counters["bad"] = 40.0
+    st = ev.evaluate()["test_ttft"]
+    assert st["state"] == WARN
+    assert 3.0 <= st["slow_burn"] < 14.0
+
+    # the burst ages out of the fast window and good traffic dilutes the
+    # slow one → recovery to OK (alerts must clear, not latch)
+    clk["t"] = 1090.0
+    counters["good"] = 3000.0
+    st = ev.evaluate()["test_ttft"]
+    assert st["state"] == OK
+    assert st["fast_burn"] == 0.0
+
+    # a sustained storm burns BOTH windows past page_burn → PAGE
+    clk["t"] = 1100.0
+    counters["bad"] = 3000.0
+    st = ev.evaluate()["test_ttft"]
+    assert st["state"] == PAGE
+    assert st["fast_burn"] >= 14.0 and st["slow_burn"] >= 14.0
+    assert ev.states() == {"test_ttft": PAGE}
+
+    # every transition is on the flight record, in order
+    ring = flight_mod.recorder.snapshot()["components"]["slo-test"]
+    hops = [
+        (e["prev"], e["state"]) for e in ring
+        if e["kind"] == "slo_state_change"
+    ]
+    assert hops == [(OK, WARN), (WARN, OK), (OK, PAGE)]
+
+    # entering PAGE dumped a parseable artifact into LAH_FLIGHT_DIR
+    artifacts = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+    assert len(artifacts) == 1 and "slo_page_test_ttft" in artifacts[0]
+    doc = json.loads((tmp_path / artifacts[0]).read_text())
+    assert doc["reason"] == "slo_page_test_ttft"
+    assert any(
+        e["kind"] == "slo_state_change"
+        for e in doc["components"]["slo-test"]
+    )
+
+    # the registry-collector form exports the paged state as lah_slo_*
+    series = ev.collect()
+    assert series["lah_slo_test_ttft_state"] == 2.0
+    assert series["lah_slo_test_ttft_objective"] == 0.99
+    assert series["lah_slo_test_ttft_bad_events_total"] == 3000.0
+    flight_mod.recorder.clear()
+
+
+def test_burn_rate_ring_stays_bounded(monkeypatch):
+    clk = {"t": 0.0}
+    monkeypatch.setattr(slo_mod, "_monotonic", lambda: clk["t"])
+    ev = SLOEvaluator(component="slo-bound")
+    ev.register(
+        BurnRateSLO("tiny", objective=0.9, fast_window_s=1, slow_window_s=5),
+        lambda: (clk["t"], 0.0),
+    )
+    for i in range(2000):
+        clk["t"] = float(i) * 0.001  # all samples inside the slow window
+        ev.evaluate()
+    with ev._lock:
+        ring = ev._entries["tiny"][2]
+    assert len(ring) <= SLOEvaluator._MAX_SAMPLES
+
+
+def test_broken_source_is_skipped_not_fatal():
+    ev = SLOEvaluator(component="slo-broken")
+
+    def boom():
+        raise RuntimeError("counter backend gone")
+
+    ev.register(BurnRateSLO("gone", objective=0.99), boom)
+    assert ev.evaluate() == {}  # skipped, evaluator survives
+
+
+# ---------------------------------------------------------------------------
+# flight recorder bounds, throttle, route
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_per_component():
+    rec = FlightRecorder(capacity=8)
+    for i in range(50):
+        rec.record("gateway", "shed", seq=i)
+    snap = rec.snapshot()
+    ring = snap["components"]["gateway"]
+    assert len(ring) == 8
+    assert [e["seq"] for e in ring] == list(range(42, 50))  # newest kept
+    assert snap["events_total"] == 50
+
+
+def test_flight_component_set_bounded_with_overflow_bucket():
+    rec = FlightRecorder(capacity=4)
+    for i in range(flight_mod.MAX_COMPONENTS + 5):
+        rec.record(f"comp{i}", "tick")
+    snap = rec.snapshot()
+    assert len(snap["components"]) <= flight_mod.MAX_COMPONENTS + 1
+    assert snap["dropped_components"] == 5
+    assert len(snap["components"]["overflow"]) == 4  # capped too
+
+
+def test_flight_dump_throttles_per_reason_and_clear_resets(
+    monkeypatch, tmp_path
+):
+    clk = {"t": 100.0}
+    monkeypatch.setattr(flight_mod, "_monotonic", lambda: clk["t"])
+    monkeypatch.setenv("LAH_FLIGHT_DIR", str(tmp_path))
+    rec = FlightRecorder(capacity=8)
+    rec.record("server", "drain_transition", state="DRAINING")
+    p1 = rec.dump("watchdog")
+    assert p1 is not None and json.loads(open(p1).read())["reason"] == (
+        "watchdog"
+    )
+    assert rec.dump("watchdog") is None  # throttled
+    assert rec.dump("sanitizer_violation") is not None  # distinct reason
+    clk["t"] += flight_mod.DUMP_MIN_INTERVAL_S + 1
+    assert rec.dump("watchdog") is not None  # throttle window elapsed
+    rec.clear()
+    assert rec.dump("watchdog") is not None  # clear resets throttle
+    assert rec.snapshot()["events_total"] == 0
+
+
+def test_flight_dump_io_failure_returns_none():
+    rec = FlightRecorder()
+    assert rec.dump("x", path="/nonexistent-dir/nope/flight.json") is None
+
+
+def test_debug_flight_route_serves_rings_as_json():
+    flight_mod.recorder.clear()
+    flight_mod.record("gateway", "preempt", sid="s-1", tokens_redone=3)
+    try:
+        srv = MetricsHTTPServer(registry=MetricsRegistry(), meta={})
+        status, ctype, body = srv._route("/debug/flight")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["components"]["gateway"][0]["kind"] == "preempt"
+        assert doc["components"]["gateway"][0]["sid"] == "s-1"
+    finally:
+        flight_mod.recorder.clear()
+
+
+def test_flight_metrics_ride_the_default_registry():
+    from learning_at_home_tpu.utils.metrics import registry
+
+    flight_mod.recorder.clear()
+    try:
+        flight_mod.record("client", "hedge_fire", primary="a", backup="b")
+        collected = registry.collect()
+        assert collected["lah_flight_events_total"] >= 1.0
+        assert "lah_flight_dumps_total" in collected
+    finally:
+        flight_mod.recorder.clear()
+
+
+# ---------------------------------------------------------------------------
+# lah_top fleet panels
+# ---------------------------------------------------------------------------
+
+
+def _peer(peer_id, snapshot, role="gateway"):
+    return {
+        "peer_id": peer_id, "role": role, "endpoint": "127.0.0.1:0",
+        "expires_at": 0.0, "snapshot": snapshot,
+    }
+
+
+def _hist_snapshot(values, with_sketch=True):
+    sk = QuantileSketch()
+    for v in values:
+        sk.add(v)
+    out = {"count": len(values), "sum": sum(values), "buckets": {}}
+    if with_sketch:
+        out["sketch"] = sk.to_dict()
+    return out
+
+
+def test_fleet_latency_rows_merge_true_quantiles_across_peers():
+    rows = [
+        _peer("gw-slow", {"metrics": {"histograms": {
+            "lah_x_seconds": _hist_snapshot([1.0] * 5),
+        }}}),
+        _peer("gw-fast", {"metrics": {"histograms": {
+            "lah_x_seconds": _hist_snapshot([0.001] * 995),
+        }}}),
+    ]
+    (row,) = lah_top.fleet_latency_rows(rows)
+    assert row["name"] == "lah_x_seconds"
+    assert row["source"] == "sketch" and row["count"] == 1000
+    # the true fleet p99 is the fast mass — the very number the MAX
+    # fallback gets 1000× wrong
+    assert abs(row["p99"] - 0.001) <= 0.001 * 0.011
+
+
+def test_fleet_latency_rows_partial_coverage_tags_max_fallback():
+    rows = [
+        _peer("gw-new", {"metrics": {"histograms": {
+            "lah_x_seconds": _hist_snapshot([0.5] * 10),
+        }}}),
+        _peer("gw-old", {"metrics": {"histograms": {
+            "lah_x_seconds": _hist_snapshot([0.5] * 10, with_sketch=False),
+        }}}),
+    ]
+    (row,) = lah_top.fleet_latency_rows(rows)
+    assert row["source"] == "sketch+MAX"
+    assert row["count"] == 20  # counts still cover everyone
+
+
+def test_fleet_latency_rows_malformed_sections_render_dashes():
+    rows = [
+        _peer("dead", None),
+        _peer("junk1", {"metrics": "nope"}),
+        _peer("junk2", {"metrics": {"histograms": [1, 2]}}),
+        _peer("junk3", {"metrics": {"histograms": {
+            "lah_x_seconds": {
+                "count": 3, "sketch": {"kind": "garbled"},
+            },
+        }}}),
+    ]
+    (row,) = lah_top.fleet_latency_rows(rows)
+    assert row["source"] == "-"
+    assert row["p50"] is None and row["p99"] is None
+    assert lah_top._q_ms(row["p99"]) == "-"
+    assert lah_top._q_ms(0.25) == "250.00"
+
+
+def test_fleet_latency_rows_labeled_histograms_merge_all_variants():
+    rows = [_peer("gw", {"metrics": {"histograms": {
+        "lah_x_seconds": {
+            '{"pool": "a"}': _hist_snapshot([0.1] * 4),
+            '{"pool": "b"}': _hist_snapshot([0.2] * 4),
+        },
+    }}})]
+    (row,) = lah_top.fleet_latency_rows(rows)
+    assert row["count"] == 8 and row["source"] == "sketch"
+    assert row["p50"] is not None
+
+
+def test_slo_rows_parse_series_and_tolerate_junk():
+    rows = [
+        _peer("gw-1", {"metrics": {"collected": {
+            "lah_slo_gateway_ttft_state": 2.0,
+            "lah_slo_gateway_ttft_fast_burn": 21.5,
+            "lah_slo_gateway_ttft_slow_burn": 16.0,
+            "lah_slo_gateway_ttft_objective": 0.99,
+        }}}),
+        _peer("gw-2", {"metrics": {"collected": {
+            "lah_slo_gateway_ttft_state": "paged",  # malformed value
+            "lah_server_jobs_processed_total": 9.0,  # not an SLO series
+        }}}),
+        _peer("dead", None),
+    ]
+    out = lah_top.slo_rows(rows)
+    assert len(out) == 2
+    assert out[0] == {
+        "peer_id": "gw-1", "slo": "gateway_ttft", "state": "PAGE",
+        "fast_burn": 21.5, "slow_burn": 16.0, "objective": 0.99,
+    }
+    assert out[1]["peer_id"] == "gw-2" and out[1]["state"] == "-"
+
+
+def test_render_includes_fleet_and_slo_panels_never_crashes_on_junk():
+    rows = [
+        _peer("gw-1", {"metrics": {
+            "collected": {
+                "lah_slo_gateway_ttft_state": 1.0,
+                "lah_slo_gateway_ttft_fast_burn": 4.0,
+                "lah_slo_gateway_ttft_slow_burn": 3.5,
+                "lah_slo_gateway_ttft_objective": 0.99,
+            },
+            "histograms": {
+                "lah_gateway_ttft_seconds": _hist_snapshot([0.05] * 20),
+            },
+        }}),
+        _peer("junk", {"metrics": {"histograms": 3, "collected": []}}),
+        _peer("dead", None),
+    ]
+    text = lah_top.render(rows, prefix="t", dead={"gone"})
+    assert "FLEET LATENCY" in text
+    assert "lah_gateway_ttft_seconds" in text
+    assert "SLO" in text and "WARN" in text
+    assert "gone" in text  # dead peers still listed

@@ -674,8 +674,13 @@ class TestDispatchWatchdog:
                              logger="learning_at_home_tpu.client.rpc"):
             with dispatch_wait_watchdog(0.01, what="fake stalled pool"):
                 time.sleep(0.3)  # the stalled dispatch wait
+            # filter on the rpc logger: the flight recorder also WARNs
+            # when it dumps its dispatch_watchdog artifact, and that
+            # line legitimately contains "watchdog"
             records = [
-                r for r in caplog.records if "watchdog" in r.getMessage()
+                r for r in caplog.records
+                if r.name == "learning_at_home_tpu.client.rpc"
+                and "watchdog" in r.getMessage()
             ]
             assert len(records) == 1
             msg = records[0].getMessage()
@@ -685,7 +690,9 @@ class TestDispatchWatchdog:
             with dispatch_wait_watchdog(0.01, what="second stall"):
                 time.sleep(0.3)
             records = [
-                r for r in caplog.records if "watchdog" in r.getMessage()
+                r for r in caplog.records
+                if r.name == "learning_at_home_tpu.client.rpc"
+                and "watchdog" in r.getMessage()
             ]
             assert len(records) == 1
         reset_dispatch_watchdog()

@@ -418,7 +418,10 @@ def smoke_worker() -> int:
     rc = slo_smoke()
     if rc:
         return rc
-    return gateway_smoke()
+    rc = gateway_smoke()
+    if rc:
+        return rc
+    return slo_trace_smoke()
 
 
 def dht_smoke() -> int:
@@ -1119,6 +1122,137 @@ def gateway_smoke() -> int:
     return 0
 
 
+def slo_trace_smoke() -> int:
+    """SLO + stream-trace gate (ISSUE 19): loadgen against an in-process
+    gateway whose TTFT objective is INTENTIONALLY impossible (1 µs), so
+    every stream is a bad event and the burn-rate evaluator must walk to
+    PAGE on both windows — and entering PAGE must write a parseable
+    flight artifact.  The same run submits one traced stream and asserts
+    trace continuity: the id echoes through gen_submit/gen_poll and
+    every gateway lifecycle span nests inside the stream umbrella."""
+    import json as _json
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from experiments.loadgen import check_floors, run_load
+    from learning_at_home_tpu.client import reset_client_rpc
+    from learning_at_home_tpu.client.routing import StaticExpertSource
+    from learning_at_home_tpu.gateway import Gateway, GatewayClient
+    from learning_at_home_tpu.models.transformer_swarm import (
+        SwarmDMoETransformerLM,
+        SwarmTransformerConfig,
+    )
+    from learning_at_home_tpu.server.server import background_server
+    from learning_at_home_tpu.utils import flight
+    from learning_at_home_tpu.utils.profiling import new_trace_id, timeline
+
+    tmpdir = tempfile.mkdtemp(prefix="lah_slo_trace_smoke_")
+    knobs = {
+        "LAH_TTFT_SLO_S": "0.000001",  # nothing serves a 1 µs TTFT
+        "LAH_TTFT_SLO_OBJECTIVE": "0.99",
+        "LAH_SLO_FAST_S": "1.0",
+        "LAH_SLO_SLOW_S": "5.0",
+        "LAH_FLIGHT_DIR": tmpdir,
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    was_profiling = timeline.enabled
+    timeline.enable()
+    timeline.clear()
+    flight.recorder.clear()  # fresh rings + dump throttle
+    uids = [f"slt{layer}.{e}" for layer in range(2) for e in range(2)]
+    try:
+        with background_server(
+            expert_uids=uids, hidden_dim=16, seed=0
+        ) as (endpoint, _srv):
+            source = StaticExpertSource({u: endpoint for u in uids})
+            cfg = SwarmTransformerConfig(
+                vocab_size=64, d_model=16, n_layers=2, n_heads=4,
+                seq_len=32, grid_size=(2,), k_best=2, k_min=2,
+                uid_prefix="slt", timeout_after_k_min=30.0,
+                forward_timeout=60.0, backward_timeout=60.0,
+                wire_codec="none", routing_cost_weight=0,
+            )
+            model = SwarmDMoETransformerLM(cfg, source)
+            params = model.init_params(jax.random.PRNGKey(0))
+            with Gateway(
+                model, params, max_slots=8, coalesce=True, page_len=8
+            ) as gw:
+                rep = run_load(
+                    gw.endpoint, rate_hz=30.0, duration_s=0.2,
+                    prompt_len=(6, 6), max_new=(6, 6), vocab=64, seed=0,
+                )
+                # the re-expressed loadgen floors: one evaluator for
+                # every "is this report healthy" question
+                violations = check_floors(rep, min_completed=2)
+                assert not violations, violations
+                # one traced stream end to end
+                client = GatewayClient(gw.endpoint)
+                tid = new_trace_id()
+                sub = client.submit([1, 2, 3, 4], 6, trace=tid)
+                assert sub.get("accepted") and sub.get("trace") == tid, sub
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline:
+                    out = client.poll(sub["sid"])
+                    if out.get("done"):
+                        break
+                    _time.sleep(0.01)
+                assert out.get("done") and out.get("trace") == tid, out
+                # every stream blew the 1 µs objective → PAGE, and the
+                # exported series agree
+                status = gw.slo.evaluate()["gateway_ttft"]
+                assert status["state"] == "page", status
+                assert status["bad_total"] >= rep["completed"]
+                series = gw.slo.collect()
+                assert series["lah_slo_gateway_ttft_state"] == 2.0
+        # PAGE entry dumped a parseable flight artifact
+        arts = [f for f in os.listdir(tmpdir) if f.endswith(".json")]
+        assert len(arts) == 1 and "slo_page_gateway_ttft" in arts[0], arts
+        with open(os.path.join(tmpdir, arts[0]), encoding="utf-8") as fh:
+            doc = _json.load(fh)
+        assert doc["reason"] == "slo_page_gateway_ttft"
+        hops = [
+            e for e in doc["components"].get("gateway", [])
+            if e["kind"] == "slo_state_change" and e["state"] == "page"
+        ]
+        assert hops, f"no page transition in artifact: {doc['components']}"
+        # trace continuity + nesting: the umbrella contains every
+        # gateway lifecycle span of the traced stream
+        spans = [s for s in timeline.spans() if s[3] == tid]
+        names = {s[0] for s in spans}
+        for needed in (
+            "gateway.admit", "gateway.pending.wait", "gateway.slot.assign",
+            "gateway.token.first", "gateway.stream",
+        ):
+            assert needed in names, (needed, names)
+        (umbrella,) = [s for s in spans if s[0] == "gateway.stream"]
+        _, u_start, u_dur, _, _ = umbrella
+        for name, start, dur, _, _ in spans:
+            if name.startswith("gateway."):
+                assert start >= u_start - 0.05, name
+                assert start + dur <= u_start + u_dur + 0.05, name
+        print(
+            f"slo_trace: {rep['completed']} streams all past the 1 µs "
+            f"objective, fast_burn={status['fast_burn']:.0f}, "
+            f"artifact={arts[0]}, {len(spans)} spans on trace {tid}"
+        )
+    finally:
+        reset_client_rpc()
+        if not was_profiling:
+            timeline.disable()
+        timeline.clear()
+        flight.recorder.clear()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    print("SLO_TRACE_SMOKE_OK page=burn-rate trace=stream-lifecycle")
+    return 0
+
+
 def run_smoke() -> int:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -1126,10 +1260,11 @@ def run_smoke() -> int:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--smoke-worker"],
             cwd=REPO, env=env, capture_output=True, text=True,
-            # eleven smokes now (client path, averaging, codec, telemetry+
+            # twelve smokes now (client path, averaging, codec, telemetry+
             # lah_top subprocess, replication, overlap, lifecycle, DHT
             # swarm sim, whole-system macro-sim, SLO churn harness,
-            # serving gateway): a wider bound than the gate's
+            # serving gateway, burn-rate SLO + stream trace): a wider
+            # bound than the gate's
             timeout=int(os.environ.get("COLLECT_GATE_SMOKE_TIMEOUT_S", "1200")),
         )
     except subprocess.TimeoutExpired:
@@ -1148,6 +1283,7 @@ def run_smoke() -> int:
         or "MACRO_SIM_OK" not in r.stdout
         or "SLO_SMOKE_OK" not in r.stdout
         or "GATEWAY_SMOKE_OK" not in r.stdout
+        or "SLO_TRACE_SMOKE_OK" not in r.stdout
     ):
         print("collect_gate: FAIL — client-path/averaging/telemetry smoke:",
               file=sys.stderr)

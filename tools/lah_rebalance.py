@@ -330,21 +330,40 @@ def sample_slo(rows: list[dict]) -> dict:
 
 
 def _slo_degraded(baseline: dict, now: dict, args) -> str:
-    """Non-empty reason string when the gate should fire."""
-    if baseline["p99_ms"] > 0 and now["p99_ms"] > max(
-        baseline["p99_ms"] * args.slo_p99_factor,
-        baseline["p99_ms"] + 5.0,
-    ):
+    """Non-empty reason string when the gate should fire.
+
+    The comparisons run through the declarative SLO engine
+    (utils/slo.py, ISSUE 19) with the bounds UNCHANGED: the p99 ceiling
+    is ``max(baseline x factor, baseline + 5 ms)`` — skipped entirely on
+    a cold baseline (p99 == 0) — and the shed ceiling is
+    ``baseline + margin``."""
+    from learning_at_home_tpu.utils.slo import Threshold, evaluate_thresholds
+
+    specs = []
+    if baseline["p99_ms"] > 0:
+        specs.append(Threshold(
+            name="dispatch_p99_ceiling", metric="p99_ms", op="<=",
+            bound=max(
+                baseline["p99_ms"] * args.slo_p99_factor,
+                baseline["p99_ms"] + 5.0,
+            ),
+        ))
+    specs.append(Threshold(
+        name="shed_fraction_ceiling", metric="shed_fraction", op="<=",
+        bound=baseline["shed_fraction"] + args.slo_shed_margin,
+    ))
+    violations = evaluate_thresholds(now, specs)
+    if not violations:
+        return ""
+    if violations[0]["slo"] == "dispatch_p99_ceiling":
         return (
             f"dispatch p99 {now['p99_ms']:.1f}ms > "
             f"{args.slo_p99_factor}x baseline {baseline['p99_ms']:.1f}ms"
         )
-    if now["shed_fraction"] > baseline["shed_fraction"] + args.slo_shed_margin:
-        return (
-            f"shed fraction {now['shed_fraction']:.3f} > baseline "
-            f"{baseline['shed_fraction']:.3f} + {args.slo_shed_margin}"
-        )
-    return ""
+    return (
+        f"shed fraction {now['shed_fraction']:.3f} > baseline "
+        f"{baseline['shed_fraction']:.3f} + {args.slo_shed_margin}"
+    )
 
 
 def _wait_migration_idle(pool, timeout_s: float = 30.0) -> dict:

@@ -169,6 +169,99 @@ def peer_gateway(row: dict) -> tuple[str, str, str, str, str]:
     )
 
 
+def fleet_latency_rows(rows: list[dict]) -> list[dict]:
+    """TRUE fleet quantiles per histogram (ISSUE 19): merge each peer's
+    wire-form DDSketch (``metrics.histograms.<name>.sketch``) bucketwise
+    and read p50/p95/p99 off the merged sketch.  Peers that export no
+    sketch (old builds, malformed sections) cannot contribute to the
+    quantile — the row's SOURCE tags that (``sketch`` = full coverage,
+    ``sketch+MAX`` = partial, so the registry's MAX-merged ``*_p99_ms``
+    series remain the authority for the uncovered peers); a name with
+    zero usable sketches renders dashes, never crashes."""
+    from learning_at_home_tpu.utils.sketch import merge_dicts, try_from_dict
+
+    per_name: dict[str, dict] = {}
+    for row in rows:
+        hists = _section(row, "metrics").get("histograms")
+        if not isinstance(hists, dict):
+            continue
+        for name, h in hists.items():
+            if not isinstance(name, str) or not isinstance(h, dict):
+                continue
+            # unlabeled histograms fold flat; labeled ones map
+            # label-string -> per-label state
+            variants = (
+                [h] if "count" in h
+                else [v for v in h.values() if isinstance(v, dict)]
+            )
+            if not variants:
+                continue
+            entry = per_name.setdefault(
+                name, {"sketches": [], "missing": 0, "count": 0.0}
+            )
+            for v in variants:
+                entry["count"] += _num(v.get("count"))
+                skd = v.get("sketch")
+                if try_from_dict(skd) is not None:
+                    entry["sketches"].append(skd)
+                else:
+                    entry["missing"] += 1
+    out = []
+    for name in sorted(per_name):
+        e = per_name[name]
+        merged = merge_dicts(e["sketches"])
+        if merged is None:
+            out.append({
+                "name": name, "source": "-", "count": int(e["count"]),
+                "p50": None, "p95": None, "p99": None,
+            })
+            continue
+        out.append({
+            "name": name,
+            "source": "sketch" if not e["missing"] else "sketch+MAX",
+            "count": int(e["count"]),
+            "p50": merged.quantile(50),
+            "p95": merged.quantile(95),
+            "p99": merged.quantile(99),
+        })
+    return out
+
+
+_SLO_STATE_NAMES = {0: "OK", 1: "WARN", 2: "PAGE"}
+
+
+def slo_rows(rows: list[dict]) -> list[dict]:
+    """Per-peer burn-rate SLO states from the ``lah_slo_<name>_*``
+    series (utils/slo.py).  Malformed values render as dashes downstream
+    — this only groups what parses."""
+    import re as _re
+
+    out = []
+    for row in rows:
+        m = _collected(row)
+        if not isinstance(m, dict):
+            continue
+        for key in sorted(k for k in m if isinstance(k, str)):
+            match = _re.fullmatch(r"lah_slo_(.+)_state", key)
+            if not match:
+                continue
+            slo = match.group(1)
+            state = _num(m.get(key), default=-1.0)
+            out.append({
+                "peer_id": row["peer_id"],
+                "slo": slo,
+                "state": _SLO_STATE_NAMES.get(int(state), "-"),
+                "fast_burn": _num(m.get(f"lah_slo_{slo}_fast_burn")),
+                "slow_burn": _num(m.get(f"lah_slo_{slo}_slow_burn")),
+                "objective": _num(m.get(f"lah_slo_{slo}_objective")),
+            })
+    return out
+
+
+def _q_ms(v) -> str:
+    return "-" if v is None else f"{1000.0 * v:.2f}"
+
+
 def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
     lines = [
         f"lah_top — telemetry.{prefix} — {len(rows)} live peer(s), "
@@ -349,6 +442,42 @@ def render(rows: list[dict], prefix: str, dead: set[str]) -> str:
             lines.append(
                 f"  {peer_id:<28.28} {k:>3} {100 * acc:>6.1f}% "
                 f"{eff:>6.2f} {rounds:>8} {100 * share:>6.1f}%"
+            )
+    # fleet latency panel (ISSUE 19): true quantiles from merged
+    # per-peer DDSketches — NOT a max-of-p99s
+    fleet = [r for r in fleet_latency_rows(rows) if r["count"]]
+    if fleet:
+        lines.append("")
+        lines.append(
+            "FLEET LATENCY (true quantiles from merged sketches; "
+            "SOURCE=sketch+MAX ⇒ some peers lacked sketches and are "
+            "covered only by the MAX-merged *_p99_ms series):"
+        )
+        lines.append(
+            f"  {'HISTOGRAM':<36} {'COUNT':>8} {'P50ms':>9} {'P95ms':>9} "
+            f"{'P99ms':>9} {'SOURCE':<11}"
+        )
+        for r in fleet:
+            lines.append(
+                f"  {r['name']:<36.36} {r['count']:>8} "
+                f"{_q_ms(r['p50']):>9} {_q_ms(r['p95']):>9} "
+                f"{_q_ms(r['p99']):>9} {r['source']:<11}"
+            )
+    # SLO panel (ISSUE 19): per-peer burn-rate objective states
+    slos = slo_rows(rows)
+    if slos:
+        lines.append("")
+        lines.append("SLO (burn-rate objectives; PAGE ⇒ flight artifact "
+                     "dumped on the peer):")
+        lines.append(
+            f"  {'PEER':<28} {'SLO':<20} {'STATE':<6} {'FAST':>7} "
+            f"{'SLOW':>7} {'OBJ':>7}"
+        )
+        for r in slos:
+            lines.append(
+                f"  {r['peer_id']:<28.28} {r['slo']:<20.20} "
+                f"{r['state']:<6} {r['fast_burn']:>7.2f} "
+                f"{r['slow_burn']:>7.2f} {r['objective']:>7.4f}"
             )
     # span-level latency only exists on peers running LAH_PROFILE=1
     p99 = {}
